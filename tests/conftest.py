@@ -1,0 +1,14 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-device logic (sharding, collectives, global-vs-local NT-Xent) is tested
+without TPU hardware via XLA's host-platform device-count flag, per the test
+strategy in SURVEY.md §4.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_ENABLE_X64", "0")

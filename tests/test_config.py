@@ -1,0 +1,122 @@
+"""Config system tests: composition, overrides, validation contracts."""
+
+import pytest
+
+from simclr_tpu.config import (
+    Config,
+    ConfigError,
+    check_eval_conf,
+    check_pretrain_conf,
+    load_config,
+    resolve_save_dir,
+)
+
+
+def test_pretrain_defaults_match_reference_tree():
+    cfg = load_config("config")
+    # /root/reference/conf/config.yaml:8-17
+    assert cfg.parameter.seed == 7
+    assert cfg.parameter.d == 128
+    assert cfg.parameter.temperature == 0.5
+    assert cfg.parameter.epochs == 1000
+    assert cfg.parameter.momentum == 0.9
+    assert cfg.parameter.warmup_epochs == 10
+    assert cfg.parameter.linear_schedule is True
+    # /root/reference/conf/experiment/cifar10.yaml:2-10
+    assert cfg.experiment.decay == 1.0e-4
+    assert cfg.experiment.lr == 1.0
+    assert cfg.experiment.strength == 0.5
+    assert cfg.experiment.base_cnn == "resnet18"
+    assert cfg.experiment.batches == 512
+    assert cfg.experiment.name == "cifar10"
+    assert cfg.mesh.data == -1
+
+
+def test_dotted_overrides_are_yaml_typed():
+    cfg = load_config(
+        "config",
+        ["parameter.epochs=200", "experiment.lr=0.5", "parameter.linear_schedule=false"],
+    )
+    assert cfg.parameter.epochs == 200
+    assert isinstance(cfg.parameter.epochs, int)
+    assert cfg.experiment.lr == 0.5
+    assert cfg.parameter.linear_schedule is False
+
+
+def test_group_choice_override_selects_cifar100():
+    cfg = load_config("config", ["experiment=cifar100"])
+    assert cfg.experiment.name == "cifar100"
+    assert cfg.experiment.output_model_name == "cifar100.pt"
+
+
+def test_eval_config_defaults():
+    cfg = load_config("eval")
+    # /root/reference/conf/eval.yaml:2-17
+    assert cfg.parameter.epochs == 100
+    assert cfg.parameter.warmup_epochs == 0
+    assert cfg.parameter.top_k == 5
+    assert cfg.parameter.use_full_encoder is False
+    assert cfg.parameter.classifier == "centroid"
+    assert cfg.experiment.decay == 0.0
+    assert cfg.experiment.lr == 0.1
+    assert cfg.experiment.target_dir == "DUMMY-PATH"
+
+
+def test_validation_rejects_bad_values():
+    cfg = load_config("config")
+    check_pretrain_conf(cfg)  # defaults pass
+    cfg.parameter.epochs = 0
+    with pytest.raises(ConfigError):
+        check_pretrain_conf(cfg)
+
+    ev = load_config("eval")
+    with pytest.raises(ConfigError):  # DUMMY-PATH target_dir must be rejected
+        check_eval_conf(ev)
+    ev.experiment.target_dir = "/tmp/ckpts"
+    check_eval_conf(ev)
+    ev.parameter.classifier = "svm"
+    with pytest.raises(ConfigError):
+        check_eval_conf(ev)
+
+
+def test_bad_override_syntax_raises():
+    with pytest.raises(ConfigError):
+        load_config("config", ["parameter.epochs"])
+
+
+def test_strict_overrides_reject_typos_but_allow_plus_prefix():
+    with pytest.raises(ConfigError):
+        load_config("config", ["parameter.eopchs=5"])  # typo'd key
+    cfg = load_config("config", ["+parameter.extra=5"])
+    assert cfg.parameter.extra == 5
+
+
+def test_scientific_notation_override_is_float():
+    cfg = load_config("config", ["experiment.decay=1e-4"])
+    assert cfg.experiment.decay == pytest.approx(1e-4)
+    assert isinstance(cfg.experiment.decay, float)
+
+
+def test_override_cannot_clobber_scalar_with_section():
+    with pytest.raises(ConfigError):
+        load_config("config", ["+parameter.epochs.typo=5"])
+
+
+def test_save_dir_resolution():
+    import datetime
+
+    cfg = load_config("config")
+    now = datetime.datetime(2026, 7, 29, 12, 34, 56)
+    assert resolve_save_dir(cfg, now) == "results/cifar10/seed-7/2026-07-29/12-34-56"
+    cfg.experiment.save_dir = "/tmp/run1"
+    assert resolve_save_dir(cfg) == "/tmp/run1"
+
+
+def test_config_node_behaves_like_mapping():
+    cfg = Config({"a": {"b": 1}})
+    assert cfg.a.b == 1
+    assert cfg.select("a.b") == 1
+    assert cfg.select("a.missing", 42) == 42
+    cfg.update_dotted("a.c.d", "x")
+    assert cfg.a.c.d == "x"
+    assert "a" in cfg and dict(cfg.a.items())["b"] == 1

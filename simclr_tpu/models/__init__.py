@@ -1,0 +1,22 @@
+from simclr_tpu.models.contrastive import ContrastiveModel, SupervisedModel
+from simclr_tpu.models.heads import (
+    LinearClassifier,
+    NonLinearClassifier,
+    ProjectionHead,
+    centroid_logits,
+    centroid_weights,
+)
+from simclr_tpu.models.resnet import FEATURE_DIMS, ResNetEncoder, feature_dim
+
+__all__ = [
+    "ContrastiveModel",
+    "SupervisedModel",
+    "LinearClassifier",
+    "NonLinearClassifier",
+    "ProjectionHead",
+    "centroid_logits",
+    "centroid_weights",
+    "ResNetEncoder",
+    "FEATURE_DIMS",
+    "feature_dim",
+]

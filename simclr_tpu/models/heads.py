@@ -1,0 +1,107 @@
+"""Projection head and downstream classifier heads (Flax linen).
+
+Capability parity with ``/root/reference/model.py``:
+  * :class:`ProjectionHead`  — Linear -> BN -> ReLU -> Linear(no bias)
+    (``model.py:65-70``), hidden width = encoder feature dim.
+  * :class:`LinearClassifier` — single affine probe (``model.py:7-21``).
+  * :class:`NonLinearClassifier` — MLP probe. The reference *imports* this
+    class but never ships it (latent defect, ``/root/reference/eval.py:16``;
+    SURVEY.md §2.5.1); reconstructed here with the ProjectionHead shape
+    (Linear -> BN -> ReLU -> Linear), the natural reading of the README's
+    nonlinear-eval rows.
+  * :class:`CentroidClassifier` — scores ``x @ W`` against per-class feature
+    means (``model.py:24-53``); weights built by :func:`centroid_weights`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class ProjectionHead(nn.Module):
+    """SimCLR non-linear projection g: h -> z."""
+
+    d: int = 128
+    axis_name: str | None = None
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, h, train: bool = True):
+        hidden = h.shape[-1]
+        y = nn.Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32, name="linear1")(h)
+        y = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.axis_name,
+            name="bn1",
+        )(y)
+        y = nn.relu(y)
+        y = nn.Dense(
+            self.d, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            name="linear2",
+        )(y)
+        return y.astype(jnp.float32)
+
+
+class LinearClassifier(nn.Module):
+    """Affine probe for the linear evaluation protocol."""
+
+    num_classes: int = 10
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            name="classifier",
+        )(x)
+
+
+class NonLinearClassifier(nn.Module):
+    """MLP probe: Linear -> BN -> ReLU -> Linear (see module docstring)."""
+
+    num_classes: int = 10
+    hidden: int | None = None  # default: input width
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        hidden = self.hidden or x.shape[-1]
+        y = nn.Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32, name="linear1")(x)
+        y = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="bn1",
+        )(y)
+        y = nn.relu(y)
+        return nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32, name="linear2"
+        )(y)
+
+
+def centroid_weights(features: jnp.ndarray, labels: jnp.ndarray, num_classes: int):
+    """Per-class mean feature vectors, stacked as a (d, num_classes) matrix.
+
+    Pure-JAX segment-mean version of the reference's
+    ``CentroidClassifier.create_weights`` (``/root/reference/model.py:36-53``).
+    """
+    one_hot = jnp.eye(num_classes, dtype=features.dtype)[labels]  # (N, C)
+    sums = features.T @ one_hot  # (d, C)
+    counts = jnp.clip(one_hot.sum(axis=0), 1.0, None)  # (C,)
+    return sums / counts
+
+
+def centroid_logits(features: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    """Scores = features @ weights, matching ``model.py:33-34``."""
+    return features @ weights

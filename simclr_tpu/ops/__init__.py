@@ -1,0 +1,15 @@
+from simclr_tpu.ops.lars import lars, scale_by_larc, simclr_weight_decay_mask
+from simclr_tpu.ops.ntxent import (
+    ntxent_loss,
+    ntxent_loss_local_negatives,
+    ntxent_loss_sharded_rows,
+)
+
+__all__ = [
+    "lars",
+    "scale_by_larc",
+    "simclr_weight_decay_mask",
+    "ntxent_loss",
+    "ntxent_loss_local_negatives",
+    "ntxent_loss_sharded_rows",
+]

@@ -1,0 +1,118 @@
+"""LARS/LARC optimizer as optax gradient transformations.
+
+The reference wraps SGD-momentum in Apex ``LARC(trust_coefficient=0.001,
+clip=False)`` (``/root/reference/main.py:85-94``): per-parameter, an adaptive
+factor ``trust * ||p|| / (||g|| + wd * ||p|| + eps)`` multiplies the
+weight-decayed gradient, after which plain (non-Nesterov) momentum SGD runs
+with its own weight decay disabled. Weight decay is masked off for biases and
+batch-norm parameters (``exclude_from_wt_decay``,
+``/root/reference/main.py:18-36``); the adaptive scaling itself applies to
+*every* parameter, matching Apex LARC (which, unlike google-research LARS,
+has no exclude-from-adaptation list).
+
+Reproduced here as an optax chain so it composes with schedules and works
+under ``jit``/GSPMD (norms of sharded params become cross-replica reductions
+automatically).
+
+Documented deviation: the reference's name-substring skip list ("bias", "bn")
+misses torchvision's ``downsample.1`` batch-norms, so those *do* get weight
+decay there; our structural mask (leaf name ``bias``/``scale``) excludes all
+norm parameters uniformly, which is the documented intent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScaleByLarcState(NamedTuple):
+    pass
+
+
+def scale_by_larc(
+    trust_coefficient: float = 0.001,
+    weight_decay: float = 0.0,
+    weight_decay_mask: Callable[[Any], Any] | None = None,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    """Apex-LARC (clip=False) gradient scaling + masked weight decay.
+
+    For each parameter: ``g_out = (g + wd_p * p) * adaptive`` where
+    ``adaptive = trust * ||p|| / (||g|| + wd_p * ||p|| + eps)`` if both norms
+    are nonzero else 1, and ``wd_p`` is ``weight_decay`` where the mask is
+    True else 0. Follow with momentum + lr scaling.
+    """
+
+    def init_fn(params):
+        del params
+        return ScaleByLarcState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("scale_by_larc requires params")
+        if weight_decay_mask is None:
+            mask = jax.tree.map(lambda _: True, updates)
+        else:
+            mask = weight_decay_mask(params)
+
+        def scale(g, p, use_wd):
+            g = g.astype(jnp.float32)
+            p = p.astype(jnp.float32)
+            wd = weight_decay if use_wd else 0.0
+            p_norm = jnp.linalg.norm(p)
+            g_norm = jnp.linalg.norm(g)
+            adaptive = trust_coefficient * p_norm / (g_norm + wd * p_norm + eps)
+            # Apex only applies decay+scaling when BOTH norms are nonzero
+            # (`if param_norm != 0 and grad_norm != 0`); a zero-grad param
+            # must pass through untouched, not decay toward zero.
+            active = (p_norm > 0.0) & (g_norm > 0.0)
+            return jnp.where(active, (g + wd * p) * adaptive, g)
+
+        updates = jax.tree.map(scale, updates, params, mask)
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def lars(
+    learning_rate: float | optax.Schedule,
+    trust_coefficient: float = 0.001,
+    weight_decay: float = 0.0,
+    weight_decay_mask: Callable[[Any], Any] | None = None,
+    momentum: float = 0.9,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    """Full reference optimizer: LARC scaling -> momentum -> -lr.
+
+    ``optax.trace(decay=momentum, nesterov=False)`` reproduces torch SGD's
+    momentum buffer (``buf = m * buf + g``, update ``-lr * buf``).
+    """
+    return optax.chain(
+        scale_by_larc(trust_coefficient, weight_decay, weight_decay_mask, eps),
+        optax.trace(decay=momentum, nesterov=False),
+        optax.scale_by_learning_rate(learning_rate),  # scales by -lr
+    )
+
+
+def simclr_weight_decay_mask(params) -> Any:
+    """True where weight decay applies: everything except biases and norm
+    scales — the reference's ("bias", "bn") skip list by structure rather
+    than name substring (see module docstring for the deviation).
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def decide(path) -> bool:
+        leaf_name = None
+        for part in reversed(path):
+            if isinstance(part, jax.tree_util.DictKey):
+                leaf_name = str(part.key)
+                break
+        return leaf_name not in ("bias", "scale")
+
+    decisions = [decide(path) for path, _ in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, decisions)

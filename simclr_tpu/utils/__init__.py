@@ -1,0 +1,7 @@
+from simclr_tpu.utils.schedule import (
+    calculate_initial_lr,
+    steps_per_epoch,
+    warmup_cosine_schedule,
+)
+
+__all__ = ["calculate_initial_lr", "steps_per_epoch", "warmup_cosine_schedule"]

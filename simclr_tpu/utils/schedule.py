@@ -1,0 +1,64 @@
+"""Learning-rate scaling and warmup+cosine schedule, reference-exact.
+
+Reproduces the reference's step accounting bit-for-bit (SURVEY §2.5.11-12),
+because LR-curve drift is one of the named hard parts for quality parity:
+
+  * base LR scaling by the PER-DEVICE batch: ``lr * B / 256`` (linear) or
+    ``lr * sqrt(B)`` (``/root/reference/lr_utils.py:5-15`` — note the
+    reference scales by the per-GPU batch, not the global batch).
+  * per-step linear warmup with the ``<=`` boundary: step ``warmup_steps``
+    itself still takes the warmup value (``/root/reference/main.py:106``).
+  * cosine annealing with ``T_max = total_steps - warmup_steps`` whose index
+    advances only *after* each post-warmup step, so step ``warmup + 1 + t``
+    uses cosine index ``t`` (``/root/reference/main.py:96-99,119-120``).
+  * ``steps_per_epoch = N // (B * n_data_shards)`` — the reference's
+    ``drop_last=True`` truncation (``/root/reference/main.py:76-77``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def calculate_initial_lr(base_lr: float, batch_size: int, linear_schedule: bool) -> float:
+    """Scaled base LR (``/root/reference/lr_utils.py:5-15``)."""
+    if linear_schedule:
+        return base_lr * batch_size / 256.0
+    return base_lr * float(jnp.sqrt(float(batch_size)))
+
+
+def steps_per_epoch(num_samples: int, per_device_batch: int, n_data_shards: int) -> int:
+    """Reference drop-last truncation (``/root/reference/main.py:76-77``)."""
+    return int(num_samples / (per_device_batch * n_data_shards))
+
+
+def warmup_cosine_schedule(
+    initial_lr: float, total_steps: int, warmup_steps: int
+):
+    """Returns ``schedule(step) -> lr`` (jnp-traceable, optax-compatible).
+
+    step <= warmup_steps : linear warmup ``step / warmup_steps * lr0``
+                           (lr0 exactly at the boundary; lr0 at step 0 when
+                           warmup_steps == 0).
+    step >  warmup_steps : ``0.5 * lr0 * (1 + cos(pi * t / T_max))`` with
+                           ``t = step - warmup_steps - 1`` and
+                           ``T_max = total_steps - warmup_steps`` — the torch
+                           CosineAnnealingLR trajectory as driven by the
+                           reference loop.
+    """
+    t_max = max(total_steps - warmup_steps, 1)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warmup_lr = jnp.where(
+            warmup_steps > 0,
+            step / jnp.maximum(warmup_steps, 1) * initial_lr,
+            initial_lr,
+        )
+        # clamp at t_max so evaluation past total_steps (resume overrun,
+        # step miscount) floors at the cosine minimum instead of wrapping up
+        t = jnp.clip(step - warmup_steps - 1.0, 0.0, float(t_max))
+        cosine_lr = 0.5 * initial_lr * (1.0 + jnp.cos(jnp.pi * t / t_max))
+        return jnp.where(step <= warmup_steps, warmup_lr, cosine_lr)
+
+    return schedule

@@ -3,12 +3,29 @@
 Multi-device logic (sharding, collectives, global-vs-local NT-Xent) is tested
 without TPU hardware via XLA's host-platform device-count flag, per the test
 strategy in SURVEY.md §4.
+
+Note: this environment's sitecustomize registers a TPU ('axon') backend at
+interpreter startup and pins it via ``jax.config.update('jax_platforms',...)``,
+which overrides the JAX_PLATFORMS env var. Backends initialize lazily, so
+updating the config back to 'cpu' here (before any test touches a device)
+wins, and XLA_FLAGS is still read at CPU-client init time.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu", (
+    f"tests must run on the virtual CPU mesh, got {jax.default_backend()}"
+)
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.device_count()}"
+)

@@ -1,0 +1,147 @@
+"""Model zoo tests: output shapes, parameter counts, BN semantics.
+
+Parameter counts are checked against analytically-derived torchvision ResNet
+counts (SURVEY.md §7 step 2) — same architecture family the reference builds
+(/root/reference/model.py:90-111) minus the dropped fc.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_tpu.models import (
+    ContrastiveModel,
+    LinearClassifier,
+    NonLinearClassifier,
+    ProjectionHead,
+    ResNetEncoder,
+    SupervisedModel,
+    centroid_logits,
+    centroid_weights,
+    feature_dim,
+)
+
+
+def n_params(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+# torchvision resnet18 without fc: 11,176,512 params; CIFAR stem swaps the
+# 7x7x3x64 stem conv (9408) for 3x3x3x64 (1728): 11,176,512 - 9408 + 1728.
+RESNET18_CIFAR_ENCODER_PARAMS = 11_176_512 - 9408 + 1728
+# torchvision resnet50 without fc: 23,508,032.
+RESNET50_ENCODER_PARAMS = 23_508_032 - 9408 + 1728  # with CIFAR stem
+# ProjectionHead on 512 features, d=128:
+# linear1 512*512+512, bn scale+bias 2*512, linear2 512*128 (no bias).
+PROJ_HEAD_PARAMS = 512 * 512 + 512 + 2 * 512 + 512 * 128
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def test_resnet18_encoder_shapes_and_params(rng):
+    enc = ResNetEncoder(base_cnn="resnet18", cifar_stem=True)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = enc.init(rng, x, train=False)
+    h = enc.apply(variables, x, train=False)
+    assert h.shape == (2, 512)
+    assert h.dtype == jnp.float32
+    assert n_params(variables["params"]) == RESNET18_CIFAR_ENCODER_PARAMS
+
+
+def test_resnet50_encoder_shapes_and_params(rng):
+    enc = ResNetEncoder(base_cnn="resnet50", cifar_stem=True)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = enc.init(rng, x, train=False)
+    h = enc.apply(variables, x, train=False)
+    assert h.shape == (2, 2048)
+    assert n_params(variables["params"]) == RESNET50_ENCODER_PARAMS
+
+
+def test_imagenet_stem_downsamples(rng):
+    enc = ResNetEncoder(base_cnn="resnet18", cifar_stem=False)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = enc.init(rng, x, train=False)
+    h = enc.apply(variables, x, train=False)
+    assert h.shape == (1, 512)
+    # 7x7 stem has more params than 3x3 stem
+    assert n_params(variables["params"]) == 11_176_512
+
+
+def test_contrastive_model_encode_vs_project(rng):
+    model = ContrastiveModel(base_cnn="resnet18", d=128)
+    x = jax.random.normal(rng, (4, 32, 32, 3))
+    variables = model.init(rng, x, train=False)
+    z = model.apply(variables, x, train=False)
+    h = model.apply(variables, x, train=False, method=model.encode)
+    assert z.shape == (4, 128)
+    assert h.shape == (4, 512)
+    expected = RESNET18_CIFAR_ENCODER_PARAMS + PROJ_HEAD_PARAMS
+    assert n_params(variables["params"]) == expected
+
+
+def test_supervised_model(rng):
+    model = SupervisedModel(base_cnn="resnet18", num_classes=10)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(rng, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    expected = RESNET18_CIFAR_ENCODER_PARAMS + 512 * 10 + 10
+    assert n_params(variables["params"]) == expected
+
+
+def test_batch_stats_update_only_in_train_mode(rng):
+    model = ContrastiveModel(base_cnn="resnet18", d=8)
+    x = jax.random.normal(rng, (4, 32, 32, 3)) * 3.0 + 1.0
+    variables = model.init(rng, x, train=True)
+    before = variables["batch_stats"]
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    after = mutated["batch_stats"]
+    diffs = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), before, after)
+    assert max(jax.tree.leaves(diffs)) > 0.0
+    # eval mode must not need mutable collections
+    _ = model.apply(variables, x, train=False)
+
+
+def test_projection_head_structure(rng):
+    head = ProjectionHead(d=128)
+    h = jax.random.normal(rng, (8, 512))
+    variables = head.init(rng, h, train=False)
+    z = head.apply(variables, h, train=False)
+    assert z.shape == (8, 128)
+    params = variables["params"]
+    assert "bias" not in params["linear2"], "final projection must be bias-free"
+    assert n_params(params) == PROJ_HEAD_PARAMS
+
+
+def test_linear_and_nonlinear_classifiers(rng):
+    x = jax.random.normal(rng, (8, 512))
+    lin = LinearClassifier(num_classes=10)
+    lv = lin.init(rng, x)
+    assert lin.apply(lv, x).shape == (8, 10)
+    assert n_params(lv["params"]) == 512 * 10 + 10
+
+    nonlin = NonLinearClassifier(num_classes=10)
+    nv = nonlin.init(rng, x, train=False)
+    assert nonlin.apply(nv, x, train=False).shape == (8, 10)
+    expected = (512 * 512 + 512) + 2 * 512 + (512 * 10 + 10)
+    assert n_params(nv["params"]) == expected
+
+
+def test_centroid_classifier_math():
+    feats = jnp.array([[1.0, 0.0], [3.0, 0.0], [0.0, 2.0], [0.0, 4.0]])
+    labels = jnp.array([0, 0, 1, 1])
+    w = centroid_weights(feats, labels, num_classes=2)
+    np.testing.assert_allclose(np.asarray(w), [[2.0, 0.0], [0.0, 3.0]])
+    logits = centroid_logits(feats, w)
+    assert logits.shape == (4, 2)
+    preds = jnp.argmax(logits, axis=1)
+    np.testing.assert_array_equal(np.asarray(preds), [0, 0, 1, 1])
+
+
+def test_bad_base_cnn_rejected(rng):
+    with pytest.raises(ValueError):
+        ResNetEncoder(base_cnn="vgg16").init(rng, jnp.zeros((1, 32, 32, 3)), train=False)

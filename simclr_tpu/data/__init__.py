@@ -1,0 +1,24 @@
+from simclr_tpu.data.augment import (
+    simclr_augment_single,
+    simclr_two_views,
+    to_float,
+)
+from simclr_tpu.data.cifar import (
+    NUM_CLASSES,
+    Dataset,
+    load_dataset,
+    synthetic_dataset,
+)
+from simclr_tpu.data.pipeline import EpochIterator, epoch_permutation
+
+__all__ = [
+    "simclr_augment_single",
+    "simclr_two_views",
+    "to_float",
+    "NUM_CLASSES",
+    "Dataset",
+    "load_dataset",
+    "synthetic_dataset",
+    "EpochIterator",
+    "epoch_permutation",
+]

@@ -1,0 +1,99 @@
+"""Host-side input pipeline: shuffle, shard, batch, device feed.
+
+Replaces the reference's ``DistributedSampler`` + ``DataLoader(num_workers=8,
+pin_memory, drop_last)`` stack (``/root/reference/main.py:169-173``) with the
+SPMD-native shape: ONE process per host iterates the epoch, draws globally
+shuffled batches, keeps only its own host's rows, and ``device_put``s them
+with a batch-sharded ``NamedSharding`` so every chip holds exactly its shard.
+Augmentation happens on device inside the jitted step (see
+``data/augment.py``), so the host only moves raw uint8.
+
+Parity points (SURVEY §2.5.11):
+  * per-epoch reshuffle seeded by ``seed + epoch`` — DistributedSampler's
+    ``set_epoch`` determinism (``/root/reference/main.py:101-102``);
+  * ``drop_last=True`` truncation: ``steps = N // global_batch``;
+  * global batch = per-device batch x number of data shards, matching the
+    reference's per-GPU ``experiment.batches`` semantics.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterator
+
+import jax
+import numpy as np
+
+from simclr_tpu.data.cifar import Dataset
+
+
+def epoch_permutation(num_samples: int, seed: int, epoch: int) -> np.ndarray:
+    """Deterministic per-epoch shuffle (DistributedSampler ``set_epoch``)."""
+    return np.random.default_rng(np.uint64(seed) + np.uint64(epoch)).permutation(
+        num_samples
+    )
+
+
+class EpochIterator:
+    """Iterates one split in globally-shuffled, host-sharded batches.
+
+    Yields dicts with uint8 ``image`` (host-local rows of the global batch)
+    and int32 ``label``. With ``sharding`` set, arrays are ``device_put`` so
+    downstream ``jit`` consumes already-sharded global arrays (single-host:
+    the full global batch; multi-host: this host's rows assembled into a
+    global array via ``make_array_from_process_local_data``).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        global_batch: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        sharding: jax.sharding.NamedSharding | None = None,
+        drop_last: bool = True,
+    ):
+        if global_batch <= 0:
+            raise ValueError("global_batch must be positive")
+        self.dataset = dataset
+        self.global_batch = global_batch
+        self.seed = seed
+        self.shuffle = shuffle
+        self.sharding = sharding
+        self.drop_last = drop_last
+        n = len(dataset)
+        self.steps_per_epoch = n // global_batch if drop_last else -(-n // global_batch)
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"dataset of {n} samples smaller than global batch {global_batch}"
+            )
+
+    def _order(self, epoch: int) -> np.ndarray:
+        if self.shuffle:
+            return epoch_permutation(len(self.dataset), self.seed, epoch)
+        return np.arange(len(self.dataset))
+
+    def batches(self, epoch: int) -> Iterator[dict[str, np.ndarray | jax.Array]]:
+        order = self._order(epoch)
+        n_proc = jax.process_count()
+        proc = jax.process_index()
+        for step in range(self.steps_per_epoch):
+            idx = order[step * self.global_batch : (step + 1) * self.global_batch]
+            # each host materializes only its contiguous row block
+            per_host = len(idx) // n_proc if n_proc > 1 else len(idx)
+            local_idx = idx[proc * per_host : (proc + 1) * per_host]
+            batch = {
+                "image": self.dataset.images[local_idx],
+                "label": self.dataset.labels[local_idx],
+            }
+            if self.sharding is not None:
+                batch = {
+                    k: self._to_device(v, k) for k, v in batch.items()
+                }
+            yield batch
+
+    def _to_device(self, array: np.ndarray, name: str) -> jax.Array:
+        sharding = self.sharding
+        if jax.process_count() > 1:
+            global_shape = (array.shape[0] * jax.process_count(), *array.shape[1:])
+            return jax.make_array_from_process_local_data(sharding, array, global_shape)
+        return jax.device_put(array, sharding)

@@ -9,8 +9,10 @@ Capability parity with ``/root/reference/model.py``:
     SURVEY.md §2.5.1); reconstructed here with the ProjectionHead shape
     (Linear -> BN -> ReLU -> Linear), the natural reading of the README's
     nonlinear-eval rows.
-  * :class:`CentroidClassifier` — scores ``x @ W`` against per-class feature
-    means (``model.py:24-53``); weights built by :func:`centroid_weights`.
+  * centroid probe — :func:`centroid_weights` builds per-class feature means
+    and :func:`centroid_logits` scores ``x @ W`` (the reference's
+    ``CentroidClassifier``, ``model.py:24-53``, as pure functions — it holds
+    no learnable state, so a Module wrapper would be ceremony).
 """
 
 from __future__ import annotations

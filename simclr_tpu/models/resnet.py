@@ -30,7 +30,7 @@ Deviations from the reference, documented:
 
 from __future__ import annotations
 
-from collections.abc import Callable, Sequence
+from collections.abc import Callable
 from functools import partial
 from typing import Any
 
@@ -194,7 +194,3 @@ class ResNetEncoder(nn.Module):
 def feature_dim(base_cnn: str) -> int:
     """Encoder output dimensionality (512 for resnet18, 2048 for resnet50)."""
     return FEATURE_DIMS[base_cnn]
-
-
-def make_blocks_spec(base_cnn: str) -> Sequence[int]:
-    return _STAGE_SIZES[base_cnn]

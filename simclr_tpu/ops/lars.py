@@ -22,15 +22,11 @@ norm parameters uniformly, which is the documented intent.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import optax
-
-
-class ScaleByLarcState(NamedTuple):
-    pass
 
 
 def scale_by_larc(
@@ -49,7 +45,7 @@ def scale_by_larc(
 
     def init_fn(params):
         del params
-        return ScaleByLarcState()
+        return optax.EmptyState()
 
     def update_fn(updates, state, params=None):
         if params is None:

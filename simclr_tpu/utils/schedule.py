@@ -17,6 +17,8 @@ because LR-curve drift is one of the named hard parts for quality parity:
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 
@@ -24,7 +26,7 @@ def calculate_initial_lr(base_lr: float, batch_size: int, linear_schedule: bool)
     """Scaled base LR (``/root/reference/lr_utils.py:5-15``)."""
     if linear_schedule:
         return base_lr * batch_size / 256.0
-    return base_lr * float(jnp.sqrt(float(batch_size)))
+    return base_lr * math.sqrt(batch_size)
 
 
 def steps_per_epoch(num_samples: int, per_device_batch: int, n_data_shards: int) -> int:

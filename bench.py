@@ -1,0 +1,94 @@
+"""Benchmark: SimCLR pretrain step throughput on the available chip(s).
+
+Times the full compiled train step — on-device two-view augmentation, two
+ResNet-18 forwards, global-negative NT-Xent, backward, psum, LARS — at the
+reference recipe's per-device batch 512, and prints ONE JSON line:
+
+    {"metric": "pretrain_imgs_per_sec_per_chip", "value": ..., "unit":
+     "imgs/sec/chip", "vs_baseline": ...}
+
+``vs_baseline``: the reference publishes NO throughput numbers (SURVEY §6 —
+its README tables are accuracy-only), so the denominator is an estimate of
+the reference stack's per-GPU rate for this exact workload (PyTorch DDP
+ResNet-18, CIFAR batch 512/GPU, two forward passes + NT-Xent) on a V100:
+~4000 imgs/sec/GPU. vs_baseline > 1 means one TPU chip outruns one reference
+GPU on the same recipe.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_tpu.data.cifar import synthetic_dataset
+from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+from simclr_tpu.parallel.mesh import DATA_AXIS, batch_sharding, create_mesh, replicated_sharding
+from simclr_tpu.parallel.steps import make_pretrain_step
+from simclr_tpu.parallel.train_state import create_train_state
+from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
+
+PER_DEVICE_BATCH = 512  # reference conf/experiment/cifar10.yaml:10
+WARMUP_STEPS = 3
+TIMED_STEPS = 20
+REFERENCE_GPU_IMGS_PER_SEC = 4000.0  # estimated; see module docstring
+
+
+def main() -> None:
+    mesh = create_mesh()
+    n_chips = mesh.size
+    global_batch = PER_DEVICE_BATCH * mesh.shape[DATA_AXIS]
+
+    model = ContrastiveModel(base_cnn="resnet18", d=128, bn_cross_replica_axis=DATA_AXIS)
+    lr0 = calculate_initial_lr(1.0, PER_DEVICE_BATCH, True)
+    schedule = warmup_cosine_schedule(lr0, total_steps=1000, warmup_steps=10)
+    tx = lars(
+        schedule, weight_decay=1e-4, weight_decay_mask=simclr_weight_decay_mask
+    )
+    state = create_train_state(
+        model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = make_pretrain_step(
+        model, tx, mesh, temperature=0.5, strength=0.5, negatives="global"
+    )
+
+    ds = synthetic_dataset("cifar10", "train", size=global_batch * 2)
+    sharding = batch_sharding(mesh)
+    batches = [
+        jax.device_put(ds.images[i * global_batch : (i + 1) * global_batch], sharding)
+        for i in range(2)
+    ]
+
+    rng = jax.random.key(0)
+    for i in range(WARMUP_STEPS):
+        state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, i))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_STEPS):
+        state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, 100 + i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = TIMED_STEPS * global_batch / dt
+    per_chip = imgs_per_sec / n_chips
+    assert np.isfinite(float(metrics["loss"]))
+    print(
+        json.dumps(
+            {
+                "metric": "pretrain_imgs_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(per_chip / REFERENCE_GPU_IMGS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -303,8 +303,8 @@ def check_pretrain_conf(cfg: Config) -> None:
         f"experiment.name must be cifar10|cifar100, got {e.name!r}",
     )
     _require(
-        cfg.select("loss.negatives", "global") in ("global", "local"),
-        "loss.negatives must be 'global' or 'local'",
+        cfg.select("loss.negatives", "global") in ("global", "local", "ring"),
+        "loss.negatives must be 'global', 'local', or 'ring'",
     )
 
 

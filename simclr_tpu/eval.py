@@ -1,0 +1,327 @@
+"""Downstream evaluation of frozen features (centroid / linear / nonlinear).
+
+TPU-native counterpart of ``/root/reference/eval.py``: for every checkpoint
+in ``experiment.target_dir``, extract frozen features of the clean (no-aug)
+train/val sets, then score a probe:
+
+  * ``centroid``  — per-class feature means, top-1/top-k accuracy
+    (``eval.py:61-85``, ``model.py:24-53``);
+  * ``linear`` / ``nonlinear`` — probe trained with SGD(nesterov) + cosine
+    over all steps, recording per-epoch train/val accuracy+loss exactly like
+    ``learnable_eval`` (``eval.py:88-190``); the reference's
+    ``NonLinearClassifier`` import is a latent defect (SURVEY §2.5.1) — the
+    class is reconstructed in ``models/heads.py``.
+
+All results land in one JSON blob (``eval.py:322-325``).
+
+    python -m simclr_tpu.eval parameter.classifier=linear \
+        experiment.target_dir=results/cifar10/seed-7/...
+
+Probe training runs as one jitted step over the device mesh with the cached
+feature matrix resident on device — the feature extraction is the only
+model-sized compute, matching the reference's structure.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from simclr_tpu.config import Config, check_eval_conf, load_config, resolve_save_dir
+from simclr_tpu.data.cifar import NUM_CLASSES, load_dataset
+from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.models.heads import (
+    LinearClassifier,
+    NonLinearClassifier,
+    centroid_logits,
+    centroid_weights,
+)
+from simclr_tpu.parallel.mesh import (
+    batch_sharding,
+    mesh_from_config,
+    validate_per_device_batch,
+)
+from simclr_tpu.parallel.steps import make_encode_step
+from simclr_tpu.utils.checkpoint import list_checkpoints, restore_checkpoint
+from simclr_tpu.utils.logging import get_logger, is_logging_host
+from simclr_tpu.utils.schedule import calculate_initial_lr
+
+logger = get_logger()
+
+
+def load_model_variables(ckpt_path: str) -> dict:
+    """Pull {params, batch_stats} out of a saved TrainState checkpoint.
+
+    The analogue of the reference's ``module.``-prefix strip + partial
+    ``load_state_dict`` (``eval.py:256-263``): our checkpoints carry the
+    whole train state; eval consumes only the model variables.
+    """
+    raw = restore_checkpoint(ckpt_path, None)
+    return {"params": raw["params"], "batch_stats": raw.get("batch_stats", {})}
+
+
+def extract_features(
+    model, variables, images: np.ndarray, mesh, batch: int, use_full_encoder: bool
+) -> np.ndarray:
+    """Frozen features of a full split, tail-padded to static batch shapes."""
+    encode = make_encode_step(model, mesh, use_full_encoder=use_full_encoder)
+    sharding = batch_sharding(mesh)
+    n = len(images)
+    steps = math.ceil(n / batch)
+    pad = steps * batch - n
+    if pad:
+        images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+    outs = []
+    for i in range(steps):
+        chunk = jax.device_put(images[i * batch : (i + 1) * batch], sharding)
+        outs.append(np.asarray(encode(variables["params"], variables["batch_stats"], chunk)))
+    return np.concatenate(outs)[:n]
+
+
+def _topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, top_k: int):
+    """(top-1 corrects, top-k corrects) as scalars."""
+    _, pred = jax.lax.top_k(logits, top_k)
+    top1 = jnp.sum(pred[:, 0] == labels)
+    topk = jnp.sum(jnp.any(pred == labels[:, None], axis=1))
+    return top1, topk
+
+
+def centroid_probe(
+    train_X, train_y, val_X, val_y, num_classes: int, top_k: int
+) -> dict:
+    """Reference centroid evaluation (``eval.py:279-293``, ``model.py:24-53``)."""
+    weights = centroid_weights(jnp.asarray(train_X), jnp.asarray(train_y), num_classes)
+
+    @jax.jit
+    def score(X, y):
+        return _topk_correct(centroid_logits(X, weights), y, top_k)
+
+    tr1, trk = score(jnp.asarray(train_X), jnp.asarray(train_y))
+    va1, vak = score(jnp.asarray(val_X), jnp.asarray(val_y))
+    return {
+        "train_acc": float(tr1) / len(train_y),
+        f"train_top_{top_k}_acc": float(trk) / len(train_y),
+        "val_acc": float(va1) / len(val_y),
+        f"val_top_{top_k}_acc": float(vak) / len(val_y),
+    }
+
+
+def learnable_probe(
+    cfg: Config,
+    kind: str,
+    train_X: np.ndarray,
+    train_y: np.ndarray,
+    val_X: np.ndarray,
+    val_y: np.ndarray,
+    num_classes: int,
+    top_k: int,
+) -> dict:
+    """Train a linear/nonlinear probe, reference-exact recipe.
+
+    SGD(nesterov=True, momentum, weight_decay=experiment.decay), initial LR
+    ``calculate_initial_lr`` of the probe config, cosine over ALL steps with
+    ``ceil`` step accounting (probe loaders have drop_last=False), scheduler
+    stepped per batch (``/root/reference/eval.py:145-159``); per-epoch full
+    train/val accuracy+loss sweeps (``eval.py:161-189``).
+    """
+    epochs = int(cfg.parameter.epochs)
+    batch = int(cfg.experiment.batches)
+    seed = int(cfg.parameter.seed)
+    n = len(train_X)
+    steps_per_epoch = math.ceil(n / batch)
+    total_steps = epochs * steps_per_epoch
+
+    lr0 = calculate_initial_lr(
+        float(cfg.experiment.lr), batch, bool(cfg.parameter.linear_schedule)
+    )
+    schedule = optax.cosine_decay_schedule(lr0, decay_steps=max(total_steps, 1))
+    tx = optax.chain(
+        optax.add_decayed_weights(float(cfg.experiment.decay)),
+        optax.trace(decay=float(cfg.parameter.momentum), nesterov=True),
+        optax.scale_by_learning_rate(schedule),
+    )
+
+    if kind == "linear":
+        clf = LinearClassifier(num_classes=num_classes)
+    else:
+        clf = NonLinearClassifier(num_classes=num_classes)
+    variables = clf.init(jax.random.key(seed), jnp.zeros((2, train_X.shape[1])))
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    has_bn = bool(batch_stats)
+    opt_state = tx.init(params)
+
+    X = jnp.asarray(train_X)
+    y = jnp.asarray(train_y)
+    Xv = jnp.asarray(val_X)
+    yv = jnp.asarray(val_y)
+
+    @jax.jit
+    def train_step(params, opt_state, batch_stats, xb, yb, mask):
+        def loss_fn(p):
+            if has_bn:
+                logits, mut = clf.apply(
+                    {"params": p, "batch_stats": batch_stats}, xb, train=True,
+                    mutable=["batch_stats"],
+                )
+                new_stats = mut["batch_stats"]
+            else:
+                logits = clf.apply({"params": p}, xb)
+                new_stats = batch_stats
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), yb
+            )
+            loss = (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            return loss, new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_stats, loss
+
+    @jax.jit
+    def dataset_metrics(params, batch_stats, Xs, ys):
+        if has_bn:
+            logits = clf.apply(
+                {"params": params, "batch_stats": batch_stats}, Xs, train=False
+            )
+        else:
+            logits = clf.apply({"params": params}, Xs)
+        logits = logits.astype(jnp.float32)
+        loss_sum = optax.softmax_cross_entropy_with_integer_labels(logits, ys).sum()
+        top1, topk = _topk_correct(logits, ys, top_k)
+        return top1, topk, loss_sum
+
+    rng = np.random.default_rng(seed)
+    train_accs, train_topk_accs, train_losses = [], [], []
+    val_accs, val_topk_accs, val_losses = [], [], []
+    for epoch in range(1, epochs + 1):
+        order = rng.permutation(n)
+        pad = steps_per_epoch * batch - n
+        padded = np.concatenate([order, np.zeros(pad, np.int64)]) if pad else order
+        mask_full = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        sum_loss = 0.0
+        for s in range(steps_per_epoch):
+            idx = padded[s * batch : (s + 1) * batch]
+            mask = jnp.asarray(mask_full[s * batch : (s + 1) * batch])
+            params, opt_state, batch_stats, loss = train_step(
+                params, opt_state, batch_stats, X[idx], y[idx], mask
+            )
+            sum_loss += float(loss) * float(mask.sum())
+
+        tr1, trk, trl = dataset_metrics(params, batch_stats, X, y)
+        va1, vak, val_ = dataset_metrics(params, batch_stats, Xv, yv)
+        train_accs.append(float(tr1) / n)
+        train_topk_accs.append(float(trk) / n)
+        train_losses.append(float(trl) / n)
+        val_accs.append(float(va1) / len(val_y))
+        val_topk_accs.append(float(vak) / len(val_y))
+        val_losses.append(float(val_) / len(val_y))
+        if is_logging_host():
+            logger.info(
+                "probe %s epoch:%d/%d loss:%.4f val_acc:%.4f",
+                kind, epoch, epochs, sum_loss / n, val_accs[-1],
+            )
+
+    return {
+        "train_accuracies": train_accs,
+        "val_accuracies": val_accs,
+        "train_losses": train_losses,
+        "val_losses": val_losses,
+        f"train_top_{top_k}_accuracies": train_topk_accs,
+        f"val_top_{top_k}_accuracies": val_topk_accs,
+        "lowest_val_loss": min(val_losses) if val_losses else None,
+        "highest_val_acc": max(val_accs) if val_accs else None,
+        "highest_val_top_k_acc": max(val_topk_accs) if val_topk_accs else None,
+    }
+
+
+def run_eval(cfg: Config) -> dict:
+    check_eval_conf(cfg)
+    mesh = mesh_from_config(cfg)
+    num_classes = NUM_CLASSES[cfg.experiment.name]
+    top_k = int(cfg.parameter.top_k)
+    synthetic_ok = bool(cfg.select("experiment.synthetic_data", False))
+    data_dir = cfg.select("experiment.data_dir")
+    train_ds = load_dataset(
+        cfg.experiment.name, "train", data_dir=data_dir, synthetic_ok=synthetic_ok,
+        synthetic_size=cfg.select("experiment.synthetic_size"),
+    )
+    val_ds = load_dataset(
+        cfg.experiment.name, "test", data_dir=data_dir, synthetic_ok=synthetic_ok,
+        synthetic_size=cfg.select("experiment.synthetic_size"),
+    )
+
+    model = ContrastiveModel(
+        base_cnn=cfg.experiment.base_cnn, d=int(cfg.parameter.d), cifar_stem=True
+    )
+    use_full_encoder = bool(cfg.parameter.use_full_encoder)
+    # feature-extraction chunk: per-device batches x data shards so sharded
+    # device_put tiles the mesh (probe training below uses the raw per-run
+    # batch, matching the reference's single-process eval loaders)
+    batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
+    classifier_kind = str(cfg.parameter.classifier)
+
+    checkpoints = list_checkpoints(str(cfg.experiment.target_dir))
+    if not checkpoints:
+        raise FileNotFoundError(
+            f"no checkpoints found under {cfg.experiment.target_dir!r}"
+        )
+
+    classification_results = {}
+    for ckpt in checkpoints:
+        key = os.path.basename(ckpt)
+        logger.info("Evaluation by using %s", key)
+        variables = load_model_variables(ckpt)
+        train_X = extract_features(
+            model, variables, train_ds.images, mesh, batch, use_full_encoder
+        )
+        val_X = extract_features(
+            model, variables, val_ds.images, mesh, batch, use_full_encoder
+        )
+
+        if classifier_kind == "centroid":
+            results = centroid_probe(
+                train_X, train_ds.labels, val_X, val_ds.labels, num_classes, top_k
+            )
+            logger.info(
+                "train acc: %s, val acc: %s", results["train_acc"], results["val_acc"]
+            )
+        else:
+            results = learnable_probe(
+                cfg, classifier_kind, train_X, train_ds.labels, val_X, val_ds.labels,
+                num_classes, top_k,
+            )
+            logger.info(
+                "train acc: %s, val acc: %s",
+                results["highest_val_acc"] and max(results["train_accuracies"]),
+                results["highest_val_acc"],
+            )
+        classification_results[key] = results
+
+    fname = str(cfg.parameter.classification_results_json_fname)
+    save_dir = resolve_save_dir(cfg)
+    if is_logging_host():
+        os.makedirs(save_dir, exist_ok=True)
+        with open(os.path.join(save_dir, fname), "w") as f:
+            json.dump(classification_results, f)
+    return classification_results
+
+
+def main(argv: list[str] | None = None) -> dict:
+    from simclr_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    cfg = load_config("eval", overrides=list(sys.argv[1:] if argv is None else argv))
+    return run_eval(cfg)
+
+
+if __name__ == "__main__":
+    main()

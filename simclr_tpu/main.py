@@ -1,0 +1,197 @@
+"""Contrastive pretraining entry point (SimCLR NT-Xent).
+
+TPU-native counterpart of ``/root/reference/main.py``: where the reference
+spawns one process per GPU via the vendored launcher and wraps the model in
+SyncBN+DDP (``main.py:134-180``), this is ONE SPMD program — a mesh over all
+chips, a jit-compiled train step (augment → two forwards → NT-Xent → psum
+grads → LARS) and a host loop that only feeds raw uint8 batches and logs.
+
+Usage (same override surface as the reference, ``README.md:17-21``):
+
+    python -m simclr_tpu.main parameter.epochs=200 experiment.batches=512
+
+Improvements over the reference, by design: full train-state checkpointing
+with resume (the reference is save-only, SURVEY §5.3-4), and a final-epoch
+checkpoint even when ``epochs % save_model_epoch != 0``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_tpu.config import (
+    Config,
+    check_pretrain_conf,
+    load_config,
+    resolve_save_dir,
+)
+from simclr_tpu.data.cifar import load_dataset
+from simclr_tpu.data.pipeline import EpochIterator
+from simclr_tpu.data.prefetch import prefetch
+from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+from simclr_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    mesh_from_config,
+    replicated_sharding,
+    validate_per_device_batch,
+)
+from simclr_tpu.parallel.steps import make_pretrain_step
+from simclr_tpu.parallel.train_state import create_train_state, param_count
+from simclr_tpu.utils.checkpoint import (
+    checkpoint_name,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from simclr_tpu.utils.logging import get_logger, is_logging_host
+from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
+
+logger = get_logger()
+
+
+def _compute_dtype(cfg: Config):
+    name = str(cfg.select("precision.compute_dtype", "bfloat16"))
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+def build_model(cfg: Config) -> ContrastiveModel:
+    return ContrastiveModel(
+        base_cnn=cfg.experiment.base_cnn,
+        d=cfg.parameter.d,
+        cifar_stem=True,
+        dtype=_compute_dtype(cfg),
+        bn_cross_replica_axis=DATA_AXIS,
+    )
+
+
+def run_pretrain(cfg: Config) -> dict:
+    """Train; returns a summary dict (final loss, steps, save_dir)."""
+    check_pretrain_conf(cfg)
+    seed = int(cfg.parameter.seed)
+
+    mesh = mesh_from_config(cfg)
+    n_data = mesh.shape[DATA_AXIS]
+    global_batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
+
+    dataset = load_dataset(
+        cfg.experiment.name,
+        "train",
+        data_dir=cfg.select("experiment.data_dir"),
+        synthetic_ok=bool(cfg.select("experiment.synthetic_data", False)),
+        synthetic_size=cfg.select("experiment.synthetic_size"),
+    )
+
+    # Reference step accounting (drop_last truncation, main.py:76-80)
+    steps_per_epoch = len(dataset) // global_batch
+    epochs = int(cfg.parameter.epochs)
+    total_steps = epochs * steps_per_epoch
+    warmup_steps = int(cfg.parameter.warmup_epochs) * steps_per_epoch
+
+    # Reference scales the base LR by the PER-DEVICE batch (lr_utils.py:11-15)
+    lr0 = calculate_initial_lr(
+        float(cfg.experiment.lr),
+        int(cfg.experiment.batches),
+        bool(cfg.parameter.linear_schedule),
+    )
+    schedule = warmup_cosine_schedule(lr0, total_steps, warmup_steps)
+    tx = lars(
+        schedule,
+        trust_coefficient=0.001,
+        weight_decay=float(cfg.experiment.decay),
+        weight_decay_mask=simclr_weight_decay_mask,
+        momentum=float(cfg.parameter.momentum),
+    )
+
+    model = build_model(cfg)
+    state = create_train_state(
+        model, tx, jax.random.key(seed), jnp.zeros((2, 32, 32, 3), jnp.float32)
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+
+    save_dir = resolve_save_dir(cfg)
+    start_epoch = 1
+    if bool(cfg.select("experiment.resume", False)):
+        ckpt = latest_checkpoint(save_dir)
+        if ckpt is not None:
+            state = restore_checkpoint(ckpt, state)
+            start_epoch = int(state.step) // max(steps_per_epoch, 1) + 1
+            logger.info("Resumed from %s at epoch %d", ckpt, start_epoch)
+
+    step_fn = make_pretrain_step(
+        model,
+        tx,
+        mesh,
+        temperature=float(cfg.parameter.temperature),
+        strength=float(cfg.experiment.strength),
+        negatives=str(cfg.select("loss.negatives", "global")),
+    )
+    data_shard = batch_sharding(mesh)
+    iterator = EpochIterator(
+        dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard
+    )
+
+    if is_logging_host():
+        os.makedirs(save_dir, exist_ok=True)
+        logger.info(
+            "pretrain %s: %d params, mesh %s, global batch %d (%d/device), "
+            "%d steps/epoch, %d epochs, lr0 %.4f, negatives=%s",
+            cfg.experiment.name, param_count(state.params), dict(mesh.shape),
+            global_batch, cfg.experiment.batches, steps_per_epoch, epochs, lr0,
+            cfg.select("loss.negatives", "global"),
+        )
+
+    base_key = jax.random.key(seed + 1)
+    metrics = {"loss": jnp.zeros(())}
+    save_model_epoch = int(cfg.experiment.save_model_epoch)
+    t_start = time.time()
+    for epoch in range(start_epoch, epochs + 1):
+        for batch in prefetch(iterator.batches(epoch)):
+            step_rng = jax.random.fold_in(base_key, int(state.step))
+            state, metrics = step_fn(state, batch["image"], step_rng)
+        if is_logging_host():
+            # one line per epoch, the reference's rank-0 log (main.py:124-127)
+            cur_step = int(state.step)
+            lr_now = float(schedule(max(cur_step - 1, 0)))
+            imgs_per_sec = (
+                (cur_step - (start_epoch - 1) * steps_per_epoch)
+                * global_batch / max(time.time() - t_start, 1e-9)
+            )
+            logger.info(
+                "Epoch:%d/%d progress:%.3f loss:%.3f, lr:%.7f, imgs/sec:%.0f",
+                epoch, epochs, epoch / epochs, float(metrics["loss"]), lr_now,
+                imgs_per_sec,
+            )
+        if epoch % save_model_epoch == 0 or epoch == epochs:
+            path = os.path.join(
+                save_dir, checkpoint_name(epoch, str(cfg.experiment.output_model_name))
+            )
+            save_checkpoint(path, state)
+
+    return {
+        "final_loss": float(metrics["loss"]),
+        "steps": int(state.step),
+        "epochs": epochs,
+        "save_dir": save_dir,
+        "global_batch": global_batch,
+        "n_data_shards": n_data,
+    }
+
+
+def main(argv: list[str] | None = None) -> dict:
+    from simclr_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    cfg = load_config("config", overrides=list(sys.argv[1:] if argv is None else argv))
+    return run_pretrain(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""SPMD parallelism: device mesh, shardings, and compiled train steps.
+
+This package is the TPU-native replacement for the reference's entire
+distributed runtime — the vendored process launcher
+(``/root/reference/launch.py``), the NCCL process-group init
+(``/root/reference/distributed_utils.py:8-24``), and the implicit DDP/SyncBN
+collectives (``/root/reference/main.py:176-178``). One process per host, one
+``jax.sharding.Mesh`` over all chips, and ``shard_map``-wrapped jitted steps
+whose collectives (psum/pmean/all_gather) XLA schedules over ICI.
+"""
+
+from simclr_tpu.parallel.mesh import (
+    MeshSpec,
+    create_mesh,
+    batch_sharding,
+    replicated_sharding,
+    mesh_from_config,
+)
+from simclr_tpu.parallel.train_state import TrainState
+
+__all__ = [
+    "MeshSpec",
+    "create_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "mesh_from_config",
+    "TrainState",
+]

@@ -1,0 +1,268 @@
+"""Compiled SPMD train/eval steps (shard_map + jit).
+
+One jitted program per entry-point hot loop, replacing the reference's
+eager-loop-plus-DDP structure (``/root/reference/main.py:104-122``,
+``supervised.py:109-139``). Each step consumes and returns the full
+:class:`~simclr_tpu.parallel.train_state.TrainState` (donated) and runs under
+``jax.shard_map`` over the (data, model) mesh so every collective is explicit:
+
+  * gradients:   ``psum`` over the data axis (the reference's DDP bucketed
+                 all-reduce, ``main.py:178``);
+  * BatchNorm:   ``pmean`` of batch statistics inside the model's forward
+                 (the reference's SyncBN, ``main.py:176``);
+  * NT-Xent:     per ``loss.negatives`` — ``all_gather`` of embeddings for
+                 global negatives (the TPU scaling axis, SURVEY §5.7) or the
+                 reference's local-batch semantics (``loss.py:25-36``);
+  * metrics:     ``psum`` of sums/corrects (the reference's explicit
+                 ``dist.reduce`` in ``supervised.py:137-139``).
+
+Augmentation runs ON DEVICE inside the same program (per-example PRNG keys
+folded with the device's data-axis index), so the host feeds raw uint8 and
+the whole step — augment, two forwards, loss, backward, LARS update — is one
+XLA computation with no host round-trips.
+
+Gradient math note: the loss functions return the GLOBAL mean loss (identical
+on every replica, collectives included), so per-replica autodiff yields each
+replica's contribution d(global loss)/d(params-via-local-batch); the ``psum``
+over the data axis then assembles the exact full gradient. This holds for
+both the gathered-negatives and local-negatives objectives.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from simclr_tpu.data.augment import simclr_augment_single, to_float
+from simclr_tpu.ops.ntxent import (
+    ntxent_loss_local_negatives,
+    ntxent_loss_sharded_rows,
+)
+from simclr_tpu.ops.ntxent_ring import ntxent_loss_ring
+from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from simclr_tpu.parallel.train_state import TrainState
+
+Metrics = dict[str, jnp.ndarray]
+
+_REP = P()          # replicated
+_BATCH = P(DATA_AXIS)  # batch dim sharded over the data axis
+
+
+def _augment_two_views(rng, images, strength, out_size):
+    """Two on-device SimCLR views of the local uint8 shard."""
+    n = images.shape[0]
+    keys = jax.random.split(rng, 2 * n)
+    aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
+    return aug(keys[:n], images, strength, out_size), aug(keys[n:], images, strength, out_size)
+
+
+def _apply_two_pass(model, params, batch_stats, v0, v1):
+    """Two sequential forwards threading BN running stats.
+
+    Matches the reference's per-view forwards (``main.py:112-113``): each
+    view's batch forms its own BN batch statistics and the running stats get
+    two momentum updates per step — NOT one concatenated 2B forward.
+    """
+    z0, mut = model.apply(
+        {"params": params, "batch_stats": batch_stats}, v0, train=True,
+        mutable=["batch_stats"],
+    )
+    z1, mut = model.apply(
+        {"params": params, "batch_stats": mut["batch_stats"]}, v1, train=True,
+        mutable=["batch_stats"],
+    )
+    return z0, z1, mut["batch_stats"]
+
+
+def make_pretrain_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    negatives: str = "global",
+    out_size: int = 32,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
+    """Build the jitted contrastive train step.
+
+    Returned callable: ``(state, images_u8, rng) -> (state, metrics)`` with
+    ``images`` the raw uint8 global batch sharded over the data axis. The
+    model must be constructed with ``bn_cross_replica_axis=DATA_AXIS``.
+    """
+    if negatives not in ("global", "local", "ring"):
+        raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
+
+    def local_step(state: TrainState, images: jnp.ndarray, rng: jax.Array):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+        v0, v1 = _augment_two_views(rng, images, strength, out_size)
+
+        def loss_fn(params):
+            z0, z1, new_stats = _apply_two_pass(model, params, state.batch_stats, v0, v1)
+            if negatives == "global":
+                loss = ntxent_loss_sharded_rows(z0, z1, DATA_AXIS, temperature)
+            elif negatives == "ring":
+                loss = ntxent_loss_ring(z0, z1, DATA_AXIS, temperature)
+            else:
+                loss = ntxent_loss_local_negatives(z0, z1, DATA_AXIS, temperature)
+            return loss, new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        grads = jax.lax.psum(grads, DATA_AXIS)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=params, batch_stats=new_stats, opt_state=new_opt
+        )
+        metrics = {"loss": loss}
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(_REP, _BATCH, _REP),
+        out_specs=_REP,
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_supervised_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> Callable[..., tuple[TrainState, Metrics]]:
+    """Jitted supervised CE train step (one SimCLR-augmented view).
+
+    The reference's supervised baseline trains on the single-view SimCLR
+    augmentation (``/root/reference/supervised.py:190,200`` uses
+    ``create_simclr_data_augmentation``) with CE loss (``supervised.py:104``).
+    """
+
+    def local_step(state: TrainState, images, labels, rng):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+        keys = jax.random.split(rng, images.shape[0])
+        aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
+        x = aug(keys, images, strength, out_size)
+
+        def loss_fn(params):
+            logits, mut = model.apply(
+                {"params": params, "batch_stats": state.batch_stats}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            per_example = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            )
+            loss = jax.lax.pmean(per_example.mean(), DATA_AXIS)
+            correct = jnp.sum(jnp.argmax(logits, -1) == labels)
+            return loss, (mut["batch_stats"], correct, per_example.shape[0])
+
+        (loss, (new_stats, correct, n_local)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads = jax.lax.psum(grads, DATA_AXIS)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=params, batch_stats=new_stats, opt_state=new_opt
+        )
+        acc = jax.lax.psum(correct, DATA_AXIS) / jax.lax.psum(
+            jnp.asarray(n_local, jnp.float32), DATA_AXIS
+        )
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(_REP, _BATCH, _BATCH, _REP),
+        out_specs=_REP,
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_supervised_eval_step(model, mesh) -> Callable[..., Metrics]:
+    """Jitted distributed validation: global sum-loss and correct counts.
+
+    The SPMD analogue of the reference's ``dist.barrier`` + two
+    ``dist.reduce(dst=0)`` calls (``/root/reference/supervised.py:137-139``)
+    — here a ``psum`` that leaves identical totals on every replica.
+    """
+
+    def local_step(params, batch_stats, images, labels):
+        x = to_float(images)
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False
+        ).astype(jnp.float32)
+        per_example = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        sum_loss = jax.lax.psum(per_example.sum(), DATA_AXIS)
+        correct = jax.lax.psum(
+            jnp.sum(jnp.argmax(logits, -1) == labels).astype(jnp.float32), DATA_AXIS
+        )
+        count = jax.lax.psum(jnp.asarray(labels.shape[0], jnp.float32), DATA_AXIS)
+        return {"sum_loss": sum_loss, "correct": correct, "count": count}
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(_REP, _REP, _BATCH, _BATCH),
+        out_specs=_REP,
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_encode_step(
+    model, mesh, *, use_full_encoder: bool = False
+) -> Callable[..., jax.Array]:
+    """Jitted frozen-feature extraction, batch-sharded in and out.
+
+    ``use_full_encoder=False`` returns encoder features h (``model.encode``,
+    reference ``eval.py:47-50`` / ``model.py:116-123``); True returns
+    projection-head output z.
+    """
+
+    @jax.jit
+    def encode(params, batch_stats, images):
+        x = to_float(images)
+        variables = {"params": params, "batch_stats": batch_stats}
+        if use_full_encoder:
+            return model.apply(variables, x, train=False).astype(jnp.float32)
+        return model.apply(
+            variables, x, train=False, method=model.encode
+        ).astype(jnp.float32)
+
+    return encode
+
+
+def make_augmented_encode_step(
+    model, mesh, *, strength: float = 0.5, out_size: int = 32,
+    use_full_encoder: bool = False,
+) -> Callable[..., jax.Array]:
+    """Features of ONE stochastic SimCLR view (feature-export averaging).
+
+    Reference: ``convert_vectors_for_contrastive`` feeds view0 of the 2-view
+    transform through the frozen model (``save_features.py:50-77,166-179``).
+    """
+
+    @jax.jit
+    def encode(params, batch_stats, images, rng):
+        keys = jax.random.split(rng, images.shape[0])
+        aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
+        x = aug(keys, images, strength, out_size)
+        variables = {"params": params, "batch_stats": batch_stats}
+        if use_full_encoder:
+            return model.apply(variables, x, train=False).astype(jnp.float32)
+        return model.apply(
+            variables, x, train=False, method=model.encode
+        ).astype(jnp.float32)
+
+    return encode

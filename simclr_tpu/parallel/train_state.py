@@ -1,0 +1,52 @@
+"""Training state pytree.
+
+The reference keeps four loose Python objects per process — DDP-wrapped
+module, SGD/LARC optimizer, torch scheduler, and the int epoch/step counters
+(``/root/reference/main.py:85-120``). Under SPMD-with-jit, all mutable train
+state must be one pytree that the compiled step consumes and returns (donated,
+so XLA updates it in place). Checkpointing this one pytree gives params +
+optimizer + step resume — a capability the reference lacks (SURVEY §5.3-4:
+save-only, params-only).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """All mutable training state as a single donated pytree.
+
+    ``step`` is the global optimizer-step counter driving the LR schedule
+    (the reference's ``current_step``, ``/root/reference/main.py:104-120``).
+    ``batch_stats`` are BatchNorm running stats — with the batch sharded over
+    the data axis these are global-batch statistics, i.e. reference SyncBN.
+    """
+
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def create_train_state(model, tx, rng: jax.Array, sample_batch: jnp.ndarray) -> TrainState:
+    """Initialize params/stats/opt-state from a sample (host-shaped) batch."""
+    variables = model.init(rng, sample_batch, train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = tx.init(params)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+    )
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))

@@ -1,0 +1,160 @@
+"""Feature export: clean and augmentation-averaged features as ``.npy``.
+
+TPU-native counterpart of ``/root/reference/save_features.py``: for each
+checkpoint in ``experiment.target_dir``,
+
+  * dump clean (no-augmentation) train/val features + labels as four ``.npy``
+    files (``save_features.py:152-163``);
+  * dump augmentation-averaged train features: a running mean over 20 passes
+    of one stochastic SimCLR view, saved at t ∈ {1, 5, 20}
+    (``save_features.py:166-179``).
+
+    python -m simclr_tpu.save_features experiment.target_dir=results/...
+
+Uses the eval config (same as the reference, ``save_features.py:119``).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+import jax
+import numpy as np
+
+from simclr_tpu.config import Config, check_save_features_conf, load_config, resolve_save_dir
+from simclr_tpu.data.cifar import load_dataset
+from simclr_tpu.eval import extract_features, load_model_variables
+from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.parallel.mesh import (
+    batch_sharding,
+    mesh_from_config,
+    validate_per_device_batch,
+)
+from simclr_tpu.parallel.steps import make_augmented_encode_step
+from simclr_tpu.utils.checkpoint import list_checkpoints
+from simclr_tpu.utils.logging import get_logger, is_logging_host
+
+logger = get_logger()
+
+# reference: 20 passes, snapshots at 1/5/20 (save_features.py:166-179)
+NUM_AUGMENTATIONS = 20
+SNAPSHOT_PASSES = (1, 5, 20)
+
+
+def augmented_features(
+    model, variables, images: np.ndarray, mesh, batch: int, strength: float,
+    seed: int, num_passes: int, snapshots: tuple[int, ...],
+    use_full_encoder: bool = False,
+) -> dict[int, np.ndarray]:
+    """Running mean of single-view augmented features, snapshotted at
+    ``snapshots`` pass counts (``/root/reference/save_features.py:166-179``)."""
+    encode = make_augmented_encode_step(
+        model, mesh, strength=strength, use_full_encoder=use_full_encoder
+    )
+    sharding = batch_sharding(mesh)
+    n = len(images)
+    steps = math.ceil(n / batch)
+    pad = steps * batch - n
+    padded = (
+        np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+        if pad
+        else images
+    )
+    mean = None
+    out: dict[int, np.ndarray] = {}
+    for t in range(1, num_passes + 1):
+        feats = []
+        for i in range(steps):
+            chunk = jax.device_put(padded[i * batch : (i + 1) * batch], sharding)
+            rng = jax.random.fold_in(jax.random.key(seed), t * steps + i)
+            feats.append(
+                np.asarray(
+                    encode(variables["params"], variables["batch_stats"], chunk, rng)
+                )
+            )
+        pass_feats = np.concatenate(feats)[:n]
+        mean = pass_feats if mean is None else mean + (pass_feats - mean) / t
+        if t in snapshots:
+            out[t] = mean.copy()
+    return out
+
+
+def run_save_features(cfg: Config) -> list[str]:
+    check_save_features_conf(cfg)
+    mesh = mesh_from_config(cfg)
+    synthetic_ok = bool(cfg.select("experiment.synthetic_data", False))
+    data_dir = cfg.select("experiment.data_dir")
+    train_ds = load_dataset(
+        cfg.experiment.name, "train", data_dir=data_dir, synthetic_ok=synthetic_ok,
+        synthetic_size=cfg.select("experiment.synthetic_size"),
+    )
+    val_ds = load_dataset(
+        cfg.experiment.name, "test", data_dir=data_dir, synthetic_ok=synthetic_ok,
+        synthetic_size=cfg.select("experiment.synthetic_size"),
+    )
+
+    model = ContrastiveModel(
+        base_cnn=cfg.experiment.base_cnn, d=int(cfg.parameter.d), cifar_stem=True
+    )
+    batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
+    use_full_encoder = bool(cfg.parameter.use_full_encoder)
+    strength = float(cfg.select("experiment.strength", 0.5))
+    seed = int(cfg.parameter.seed)
+    out_dir = resolve_save_dir(cfg)
+    if is_logging_host():
+        os.makedirs(out_dir, exist_ok=True)
+
+    written: list[str] = []
+
+    def save(name: str, array: np.ndarray) -> None:
+        path = os.path.join(out_dir, name)
+        if is_logging_host():
+            np.save(path, array)
+        written.append(path)
+
+    checkpoints = list_checkpoints(str(cfg.experiment.target_dir))
+    if not checkpoints:
+        raise FileNotFoundError(
+            f"no checkpoints found under {cfg.experiment.target_dir!r}"
+        )
+
+    for ckpt in checkpoints:
+        key = os.path.basename(ckpt)
+        logger.info("Extracting features with %s", key)
+        variables = load_model_variables(ckpt)
+
+        # clean features, train + val (reference save_features.py:152-163)
+        train_X = extract_features(
+            model, variables, train_ds.images, mesh, batch, use_full_encoder
+        )
+        val_X = extract_features(
+            model, variables, val_ds.images, mesh, batch, use_full_encoder
+        )
+        save(f"{key}.train.features.npy", train_X)
+        save(f"{key}.train.labels.npy", train_ds.labels)
+        save(f"{key}.val.features.npy", val_X)
+        save(f"{key}.val.labels.npy", val_ds.labels)
+
+        # augmentation-averaged train features (save_features.py:166-179)
+        snapshots = augmented_features(
+            model, variables, train_ds.images, mesh, batch, strength, seed,
+            NUM_AUGMENTATIONS, SNAPSHOT_PASSES, use_full_encoder,
+        )
+        for t, mean in snapshots.items():
+            save(f"{key}.train.aug-{t}.features.npy", mean)
+
+    return written
+
+
+def main(argv: list[str] | None = None) -> list[str]:
+    from simclr_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    cfg = load_config("eval", overrides=list(sys.argv[1:] if argv is None else argv))
+    return run_save_features(cfg)
+
+
+if __name__ == "__main__":
+    main()

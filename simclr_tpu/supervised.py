@@ -1,0 +1,217 @@
+"""Fully-supervised baseline entry point.
+
+TPU-native counterpart of ``/root/reference/supervised.py``: same SPMD shape
+as pretraining but cross-entropy on :class:`SupervisedModel`, with a
+distributed validation pass after every epoch — the reference's
+``dist.barrier`` + ``dist.reduce`` sums (``supervised.py:137-139``) become a
+``psum`` inside one jitted eval step. Keeps only the best checkpoint by
+validation loss or accuracy, deleting the previous best
+(``supervised.py:144-162``).
+
+    python -m simclr_tpu.supervised parameter.epochs=200
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from simclr_tpu.config import Config, check_supervised_conf, load_config, resolve_save_dir
+from simclr_tpu.data.cifar import NUM_CLASSES, load_dataset
+from simclr_tpu.data.pipeline import EpochIterator
+from simclr_tpu.data.prefetch import prefetch
+from simclr_tpu.models.contrastive import SupervisedModel
+from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+from simclr_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    mesh_from_config,
+    replicated_sharding,
+    validate_per_device_batch,
+)
+from simclr_tpu.parallel.steps import make_supervised_eval_step, make_supervised_step
+from simclr_tpu.parallel.train_state import create_train_state, param_count
+from simclr_tpu.utils.checkpoint import checkpoint_name, delete_checkpoint, save_checkpoint
+from simclr_tpu.utils.logging import get_logger, is_logging_host
+from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
+
+logger = get_logger()
+
+
+def _compute_dtype(cfg: Config):
+    name = str(cfg.select("precision.compute_dtype", "bfloat16"))
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+def run_supervised(cfg: Config) -> dict:
+    check_supervised_conf(cfg)
+    seed = int(cfg.parameter.seed)
+
+    mesh = mesh_from_config(cfg)
+    global_batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
+    synthetic_ok = bool(cfg.select("experiment.synthetic_data", False))
+    data_dir = cfg.select("experiment.data_dir")
+    train_ds = load_dataset(
+        cfg.experiment.name, "train", data_dir=data_dir, synthetic_ok=synthetic_ok,
+        synthetic_size=cfg.select("experiment.synthetic_size"),
+    )
+    val_ds = load_dataset(
+        cfg.experiment.name, "test", data_dir=data_dir, synthetic_ok=synthetic_ok,
+        synthetic_size=cfg.select("experiment.synthetic_size"),
+    )
+    num_classes = NUM_CLASSES[cfg.experiment.name]
+
+    steps_per_epoch = len(train_ds) // global_batch
+    epochs = int(cfg.parameter.epochs)
+    total_steps = epochs * steps_per_epoch
+    warmup_steps = int(cfg.parameter.warmup_epochs) * steps_per_epoch
+
+    lr0 = calculate_initial_lr(
+        float(cfg.experiment.lr),
+        int(cfg.experiment.batches),
+        bool(cfg.parameter.linear_schedule),
+    )
+    schedule = warmup_cosine_schedule(lr0, total_steps, warmup_steps)
+    tx = lars(
+        schedule,
+        trust_coefficient=0.001,
+        weight_decay=float(cfg.experiment.decay),
+        weight_decay_mask=simclr_weight_decay_mask,
+        momentum=float(cfg.parameter.momentum),
+    )
+
+    model = SupervisedModel(
+        base_cnn=cfg.experiment.base_cnn,
+        num_classes=num_classes,
+        cifar_stem=True,
+        dtype=_compute_dtype(cfg),
+        bn_cross_replica_axis=DATA_AXIS,
+    )
+    state = create_train_state(
+        model, tx, jax.random.key(seed), jnp.zeros((2, 32, 32, 3), jnp.float32)
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+
+    train_step = make_supervised_step(
+        model, tx, mesh, strength=float(cfg.experiment.strength)
+    )
+    eval_step = make_supervised_eval_step(model, mesh)
+    data_shard = batch_sharding(mesh)
+    train_iter = EpochIterator(
+        train_ds, global_batch, seed=seed, shuffle=True, sharding=data_shard
+    )
+    # validation: no shuffle, keep every sample (reference drop_last=False,
+    # supervised.py:219-223). Tail remainder is evaluated in a host-side pass.
+    val_steps = len(val_ds) // global_batch
+    val_tail = len(val_ds) - val_steps * global_batch
+
+    save_dir = resolve_save_dir(cfg)
+    metric = str(cfg.parameter.metric)
+    if is_logging_host():
+        os.makedirs(save_dir, exist_ok=True)
+        logger.info(
+            "supervised %s: %d params, mesh %s, global batch %d, %d epochs, lr0 %.4f",
+            cfg.experiment.name, param_count(state.params), dict(mesh.shape),
+            global_batch, epochs, lr0,
+        )
+
+    base_key = jax.random.key(seed + 1)
+    best_value = None
+    best_path = None
+    best_epoch = 0
+    history = []
+    t_start = time.time()
+    for epoch in range(1, epochs + 1):
+        train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
+        for batch in prefetch(train_iter.batches(epoch)):
+            step_rng = jax.random.fold_in(base_key, int(state.step))
+            state, train_metrics = train_step(
+                state, batch["image"], batch["label"], step_rng
+            )
+
+        # distributed validation (reference supervised.py:30-58,135-139)
+        sum_loss, correct, count = 0.0, 0.0, 0.0
+        for start in range(0, val_steps * global_batch, global_batch):
+            totals = eval_step(
+                state.params,
+                state.batch_stats,
+                jax.device_put(val_ds.images[start : start + global_batch], data_shard),
+                jax.device_put(val_ds.labels[start : start + global_batch], data_shard),
+            )
+            sum_loss += float(totals["sum_loss"])
+            correct += float(totals["correct"])
+            count += float(totals["count"])
+        if val_tail:
+            # remainder batch doesn't tile the mesh; replicate and slice on host
+            tail_img = val_ds.images[val_steps * global_batch :]
+            tail_lbl = val_ds.labels[val_steps * global_batch :]
+            logits = model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                jnp.asarray(tail_img, jnp.float32) / 255.0,
+                train=False,
+            ).astype(jnp.float32)
+            sum_loss += float(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, jnp.asarray(tail_lbl)
+                ).sum()
+            )
+            correct += float(np.sum(np.argmax(np.asarray(logits), -1) == tail_lbl))
+            count += float(val_tail)
+
+        val_loss = sum_loss / max(count, 1.0)
+        val_acc = correct / max(count, 1.0)
+        history.append({"epoch": epoch, "val_loss": val_loss, "val_acc": val_acc})
+        if is_logging_host():
+            logger.info(
+                "Epoch:%d/%d progress:%.3f train_loss:%.3f val_loss:%.4f "
+                "val_acc:%.4f lr:%.7f",
+                epoch, epochs, epoch / epochs, float(train_metrics["loss"]),
+                val_loss, val_acc, float(schedule(max(int(state.step) - 1, 0))),
+            )
+
+        # best-only checkpoint policy (reference supervised.py:144-162)
+        value = val_loss if metric == "loss" else val_acc
+        improved = best_value is None or (
+            value < best_value if metric == "loss" else value > best_value
+        )
+        if improved:
+            if best_path is not None:
+                delete_checkpoint(best_path)
+            best_value = value
+            best_epoch = epoch
+            best_path = os.path.join(
+                save_dir,
+                checkpoint_name(epoch, f"supervised-{cfg.experiment.name}.pt"),
+            )
+            save_checkpoint(best_path, state)
+
+    del t_start
+    return {
+        "best_epoch": best_epoch,
+        "best_value": best_value,
+        "best_path": best_path,
+        "metric": metric,
+        "history": history,
+        "save_dir": save_dir,
+        "steps": int(state.step),
+    }
+
+
+def main(argv: list[str] | None = None) -> dict:
+    from simclr_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    cfg = load_config(
+        "supervised_config", overrides=list(sys.argv[1:] if argv is None else argv)
+    )
+    return run_supervised(cfg)
+
+
+if __name__ == "__main__":
+    main()

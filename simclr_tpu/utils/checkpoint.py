@@ -1,0 +1,88 @@
+"""Checkpoint save/restore (orbax) — params + optimizer + step.
+
+The reference is save-only and params-only: rank 0 ``torch.save``s the
+DDP-wrapped ``state_dict`` every ``save_model_epoch`` epochs
+(``/root/reference/main.py:129-131``) and nothing can resume mid-run (SURVEY
+§5.3-4). Here the whole :class:`TrainState` pytree (params, BN stats,
+optimizer state, step counter) round-trips through orbax, giving exact
+resume; loading just the model variables for eval/export is the restricted
+case of the same mechanism.
+
+Checkpoint directories are ``<save_dir>/epoch=<E>-<name>`` mirroring the
+reference's ``epoch={E}-{output_model_name}`` filename scheme
+(``main.py:129-131``) minus the ``.pt`` suffix, so downstream globbing in
+eval/save_features enumerates them the same way the reference globs ``*.pt``
+(``eval.py:248``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+import orbax.checkpoint as ocp
+
+_EPOCH_RE = re.compile(r"epoch=(\d+)-")
+
+
+def checkpoint_name(epoch: int, output_model_name: str) -> str:
+    """``epoch=<E>-<stem>`` (reference: ``f"epoch={epoch}-{name}.pt"``)."""
+    stem = output_model_name
+    if stem.endswith(".pt"):
+        stem = stem[: -len(".pt")]
+    return f"epoch={epoch}-{stem}"
+
+
+def epoch_of(path: str) -> int:
+    """Parse the epoch out of a checkpoint directory name (-1 if absent)."""
+    m = _EPOCH_RE.search(os.path.basename(path.rstrip("/")))
+    return int(m.group(1)) if m else -1
+
+
+def list_checkpoints(target_dir: str) -> list[str]:
+    """All checkpoint dirs under ``target_dir``, epoch-sorted.
+
+    The eval/export analogue of the reference's ``Path(...).glob("*.pt")``
+    (``/root/reference/eval.py:248``).
+    """
+    if not os.path.isdir(target_dir):
+        return []
+    out = []
+    for entry in os.listdir(target_dir):
+        full = os.path.join(target_dir, entry)
+        if os.path.isdir(full) and _EPOCH_RE.search(entry):
+            out.append(full)
+    return sorted(out, key=epoch_of)
+
+
+def save_checkpoint(path: str, state) -> None:
+    """Save a pytree (TrainState or plain dict) to ``path`` atomically."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+
+
+def restore_checkpoint(path: str, target=None):
+    """Restore into the structure/shardings of ``target``; with ``target=None``
+    return the raw pytree (dict of numpy arrays) — the eval/export load path."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is None:
+            return ckptr.restore(path)
+        return ckptr.restore(path, target)
+
+
+def delete_checkpoint(path: str) -> None:
+    """Remove a checkpoint directory (the supervised best-only policy,
+    ``/root/reference/supervised.py:151-162``)."""
+    import shutil
+
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+
+
+def latest_checkpoint(save_dir: str) -> str | None:
+    """Newest checkpoint in a run dir, for ``--resume`` semantics."""
+    ckpts = list_checkpoints(save_dir)
+    return ckpts[-1] if ckpts else None

@@ -1,0 +1,31 @@
+"""Run logging, reference-style.
+
+The reference configures stdlib logging per entry point with a plain
+StreamHandler and logs one line per epoch from rank 0 only
+(``/root/reference/main.py:136-141,124-127``). Under SPMD there is one
+process per host; process 0 is the logging host (the rank-0 analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+
+def get_logger(name: str = "simclr_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def is_logging_host() -> bool:
+    """True on the process that logs/saves (the reference's rank-0 gate,
+    ``/root/reference/main.py:124``)."""
+    return jax.process_index() == 0

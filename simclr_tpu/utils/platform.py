@@ -1,0 +1,22 @@
+"""Restore standard ``JAX_PLATFORMS`` semantics for CLI entry points.
+
+Some environments pin a platform via ``jax.config.update('jax_platforms',
+...)`` in ``sitecustomize`` at interpreter startup, which silently overrides
+the ``JAX_PLATFORMS`` environment variable users rely on (e.g.
+``JAX_PLATFORMS=cpu python -m simclr_tpu.main ...`` for a CPU-mesh smoke
+run). Calling :func:`ensure_platform` before first device use re-applies the
+environment variable with config precedence. No-op when the variable is
+unset or devices are already initialized.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def ensure_platform() -> None:
+    env = os.environ.get("JAX_PLATFORMS", "").strip()
+    if env:
+        jax.config.update("jax_platforms", env)

@@ -1,0 +1,78 @@
+"""Profiling & step-timing — a subsystem the reference lacks entirely.
+
+SURVEY §5.1: the reference has no profiler hooks or timers anywhere. TPU
+builds live or die by the profile, so this module provides:
+
+  * :func:`start_server` — ``jax.profiler`` trace server for live capture
+    (connect with TensorBoard / xprof);
+  * :func:`trace` — context manager writing a trace for a code region;
+  * :class:`StepTimer` — ``block_until_ready``-bracketed step timing with
+    imgs/sec and imgs/sec/chip (the BASELINE.json north-star metric).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+def start_server(port: int = 9999):
+    """Start the profiler server; returns the server object (keep it alive)."""
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a profiler trace of the enclosed region into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Steady-state throughput measurement for a compiled step.
+
+    Usage::
+
+        timer = StepTimer(global_batch, warmup=3)
+        for i in range(n):
+            out = step(...)
+            timer.tick(out)        # blocks on the first post-warmup tick only
+        print(timer.summary())
+    """
+
+    def __init__(self, global_batch: int, warmup: int = 3):
+        self.global_batch = global_batch
+        self.warmup = warmup
+        self._count = 0
+        self._t0: float | None = None
+        self._timed_steps = 0
+        self._last = None
+
+    def tick(self, device_output=None) -> None:
+        self._count += 1
+        self._last = device_output
+        if self._count == self.warmup:
+            if device_output is not None:
+                jax.block_until_ready(device_output)
+            self._t0 = time.perf_counter()
+        elif self._count > self.warmup:
+            self._timed_steps += 1
+
+    def summary(self) -> dict:
+        if self._t0 is None or self._timed_steps == 0:
+            return {"imgs_per_sec": 0.0, "imgs_per_sec_per_chip": 0.0, "steps": 0}
+        if self._last is not None:
+            jax.block_until_ready(self._last)
+        dt = time.perf_counter() - self._t0
+        imgs_per_sec = self._timed_steps * self.global_batch / dt
+        return {
+            "imgs_per_sec": imgs_per_sec,
+            "imgs_per_sec_per_chip": imgs_per_sec / jax.device_count(),
+            "steps": self._timed_steps,
+            "seconds": dt,
+        }

@@ -1,0 +1,152 @@
+"""Import reference PyTorch checkpoints into Flax variables (parity shim).
+
+Lets users of the reference bring their trained ``*.pt`` state dicts
+(saved by ``/root/reference/main.py:129-131`` — DDP-wrapped, so keys carry a
+``module.`` prefix) straight into this framework for eval/export, and lets
+the test suite check numerical parity model-against-model.
+
+Key mapping (torchvision resnet18/50 + reference heads -> our Flax tree):
+
+  torchvision                      flax (this repo)
+  ------------------------------   -----------------------------------------
+  f.conv1.weight                   f/stem_conv/kernel          (OIHW->HWIO)
+  f.bn1.{weight,bias}              f/BatchNorm_0/{scale,bias}
+  f.bn1.running_{mean,var}         batch_stats f/BatchNorm_0/{mean,var}
+  f.layerL.B.convN.weight          f/Block_{i}/Conv_{N-1}/kernel
+  f.layerL.B.bnN.*                 f/Block_{i}/BatchNorm_{N-1}/*
+  f.layerL.B.downsample.0/1        f/Block_{i}/Conv_{last}/BatchNorm_{last}
+  g.projection_head.0.{weight,b}   g/linear1/{kernel,bias}     (OI->IO)
+  g.projection_head.1.*            g/bn1/*
+  g.projection_head.3.weight       g/linear2/kernel
+  fc.{weight,bias}                 fc/{kernel,bias}            (SupervisedModel)
+
+where Block is BasicBlock (resnet18) or BottleneckBlock (resnet50) and ``i``
+counts blocks across stages in order. torch tensors are converted via
+numpy; torch itself is an optional dependency (only needed to unpickle
+``.pt`` files — dict inputs work without it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+_STAGE_SIZES = {"resnet18": (2, 2, 2, 2), "resnet50": (3, 4, 6, 3)}
+_CONVS_PER_BLOCK = {"resnet18": 2, "resnet50": 3}
+_BLOCK_NAME = {"resnet18": "BasicBlock", "resnet50": "BottleneckBlock"}
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    return t.detach().cpu().numpy()  # torch tensor
+
+
+def _conv(w) -> np.ndarray:
+    """torch OIHW -> flax HWIO."""
+    return _to_numpy(w).transpose(2, 3, 1, 0)
+
+
+def _linear(w) -> np.ndarray:
+    """torch (out, in) -> flax (in, out)."""
+    return _to_numpy(w).T
+
+
+def strip_ddp_prefix(state_dict: Mapping[str, Any]) -> dict[str, Any]:
+    """Remove the DDP ``module.`` prefix (``/root/reference/eval.py:257``)."""
+    return {k.removeprefix("module."): v for k, v in state_dict.items()}
+
+
+def _set(tree: dict, path: tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for part in path[:-1]:
+        node = node.setdefault(part, {})
+    node[path[-1]] = value
+
+
+def _import_bn(
+    params: dict, stats: dict, prefix: tuple[str, ...], sd: Mapping, torch_key: str
+) -> None:
+    _set(params, prefix + ("scale",), _to_numpy(sd[f"{torch_key}.weight"]))
+    _set(params, prefix + ("bias",), _to_numpy(sd[f"{torch_key}.bias"]))
+    _set(stats, prefix + ("mean",), _to_numpy(sd[f"{torch_key}.running_mean"]))
+    _set(stats, prefix + ("var",), _to_numpy(sd[f"{torch_key}.running_var"]))
+
+
+def _import_encoder(
+    params: dict, stats: dict, sd: Mapping, base_cnn: str, torch_prefix: str = "f."
+) -> None:
+    block_name = _BLOCK_NAME[base_cnn]
+    n_convs = _CONVS_PER_BLOCK[base_cnn]
+
+    _set(params, ("f", "stem_conv", "kernel"), _conv(sd[f"{torch_prefix}conv1.weight"]))
+    _import_bn(params, stats, ("f", "BatchNorm_0"), sd, f"{torch_prefix}bn1")
+
+    block_idx = 0
+    for stage, num_blocks in enumerate(_STAGE_SIZES[base_cnn], start=1):
+        for b in range(num_blocks):
+            tp = f"{torch_prefix}layer{stage}.{b}."
+            fp = ("f", f"{block_name}_{block_idx}")
+            for c in range(n_convs):
+                _set(
+                    params, fp + (f"Conv_{c}", "kernel"),
+                    _conv(sd[f"{tp}conv{c + 1}.weight"]),
+                )
+                _import_bn(params, stats, fp + (f"BatchNorm_{c}",), sd, f"{tp}bn{c + 1}")
+            if f"{tp}downsample.0.weight" in sd:
+                _set(
+                    params, fp + (f"Conv_{n_convs}", "kernel"),
+                    _conv(sd[f"{tp}downsample.0.weight"]),
+                )
+                _import_bn(
+                    params, stats, fp + (f"BatchNorm_{n_convs}",), sd, f"{tp}downsample.1"
+                )
+            block_idx += 1
+
+
+def import_contrastive_state_dict(
+    state_dict: Mapping[str, Any], base_cnn: str = "resnet18"
+) -> dict[str, Any]:
+    """Reference ``ContrastiveModel`` state dict -> ``{params, batch_stats}``.
+
+    Covers encoder ``f`` plus projection head ``g`` (Linear->BN1d->ReLU->
+    Linear-no-bias, ``/root/reference/model.py:65-70``).
+    """
+    sd = strip_ddp_prefix(state_dict)
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
+    _import_encoder(params, stats, sd, base_cnn)
+
+    _set(params, ("g", "linear1", "kernel"), _linear(sd["g.projection_head.0.weight"]))
+    _set(params, ("g", "linear1", "bias"), _to_numpy(sd["g.projection_head.0.bias"]))
+    _import_bn(params, stats, ("g", "bn1"), sd, "g.projection_head.1")
+    _set(params, ("g", "linear2", "kernel"), _linear(sd["g.projection_head.3.weight"]))
+    return {"params": params, "batch_stats": stats}
+
+
+def import_supervised_state_dict(
+    state_dict: Mapping[str, Any], base_cnn: str = "resnet18"
+) -> dict[str, Any]:
+    """Reference ``SupervisedModel`` state dict (encoder + ``fc`` head)."""
+    sd = strip_ddp_prefix(state_dict)
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
+    _import_encoder(params, stats, sd, base_cnn)
+    _set(params, ("fc", "kernel"), _linear(sd["fc.weight"]))
+    _set(params, ("fc", "bias"), _to_numpy(sd["fc.bias"]))
+    return {"params": params, "batch_stats": stats}
+
+
+def load_torch_checkpoint(
+    path: str, base_cnn: str = "resnet18", kind: str = "contrastive"
+) -> dict[str, Any]:
+    """Load a reference ``.pt`` file from disk (requires torch to unpickle)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if kind == "contrastive":
+        return import_contrastive_state_dict(sd, base_cnn)
+    if kind == "supervised":
+        return import_supervised_state_dict(sd, base_cnn)
+    raise ValueError(f"kind must be contrastive|supervised, got {kind!r}")

@@ -1,0 +1,81 @@
+"""Orbax checkpoint round-trip + naming-scheme tests."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_tpu.ops.lars import lars
+from simclr_tpu.parallel.train_state import TrainState
+from simclr_tpu.utils.checkpoint import (
+    checkpoint_name,
+    delete_checkpoint,
+    epoch_of,
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def _tiny_state(seed=0) -> TrainState:
+    params = {"dense": {"kernel": jnp.ones((4, 2)) * seed, "bias": jnp.zeros(2)}}
+    tx = lars(0.1)
+    return TrainState(
+        step=jnp.asarray(3, jnp.int32),
+        params=params,
+        batch_stats={"bn": {"mean": jnp.ones(2)}},
+        opt_state=tx.init(params),
+    )
+
+
+class TestNaming:
+    def test_checkpoint_name_strips_pt(self):
+        assert checkpoint_name(100, "cifar10.pt") == "epoch=100-cifar10"
+        assert checkpoint_name(7, "model") == "epoch=7-model"
+
+    def test_epoch_of(self):
+        assert epoch_of("/x/epoch=200-cifar10") == 200
+        assert epoch_of("/x/not-a-ckpt") == -1
+
+    def test_list_sorted_by_epoch(self, tmp_path):
+        for e in (100, 20, 3):
+            os.makedirs(tmp_path / f"epoch={e}-m")
+        os.makedirs(tmp_path / "unrelated")
+        got = [epoch_of(p) for p in list_checkpoints(str(tmp_path))]
+        assert got == [3, 20, 100]
+
+    def test_list_missing_dir(self):
+        assert list_checkpoints("/nonexistent/dir") == []
+
+
+class TestRoundTrip:
+    def test_save_restore_with_target(self, tmp_path):
+        state = _tiny_state(seed=2)
+        path = str(tmp_path / "epoch=3-m")
+        save_checkpoint(path, state)
+        restored = restore_checkpoint(path, _tiny_state(seed=0))
+        assert int(restored.step) == 3
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["dense"]["kernel"]),
+            np.asarray(state.params["dense"]["kernel"]),
+        )
+
+    def test_restore_raw(self, tmp_path):
+        state = _tiny_state(seed=5)
+        path = str(tmp_path / "epoch=1-m")
+        save_checkpoint(path, state)
+        raw = restore_checkpoint(path)
+        assert int(raw["step"]) == 3
+        np.testing.assert_array_equal(
+            np.asarray(raw["params"]["dense"]["kernel"]), np.full((4, 2), 5.0)
+        )
+
+    def test_latest_and_delete(self, tmp_path):
+        for e in (1, 2):
+            save_checkpoint(str(tmp_path / f"epoch={e}-m"), _tiny_state(e))
+        latest = latest_checkpoint(str(tmp_path))
+        assert epoch_of(latest) == 2
+        delete_checkpoint(latest)
+        assert epoch_of(latest_checkpoint(str(tmp_path))) == 1

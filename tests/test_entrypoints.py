@@ -1,0 +1,176 @@
+"""End-to-end entry-point tests on the 8-device CPU mesh (SURVEY §4):
+tiny-epoch pretrain → eval → save_features round trip on synthetic data,
+plus the supervised baseline. These are the integration gate: every layer
+(config, data, model, loss, optimizer, SPMD steps, checkpointing, probes,
+JSON/npy outputs) runs in one pipe.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from simclr_tpu.eval import main as eval_main
+from simclr_tpu.main import main as pretrain_main
+from simclr_tpu.save_features import main as save_features_main
+from simclr_tpu.supervised import main as supervised_main
+
+SYNTH = [
+    "experiment.synthetic_data=true",
+    "experiment.synthetic_size=64",
+    "experiment.batches=4",  # x8 devices = global batch 32 -> 2 steps/epoch
+]
+
+
+@pytest.fixture(scope="module")
+def pretrain_run(tmp_path_factory):
+    """One tiny pretrain run shared by the downstream entry-point tests."""
+    save_dir = str(tmp_path_factory.mktemp("pretrain"))
+    summary = pretrain_main(
+        SYNTH
+        + [
+            "parameter.epochs=2",
+            "parameter.warmup_epochs=1",
+            "experiment.save_model_epoch=1",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    return summary
+
+
+class TestPretrain:
+    def test_summary(self, pretrain_run):
+        assert pretrain_run["steps"] == 4  # 2 epochs x (64 // 32) steps
+        assert np.isfinite(pretrain_run["final_loss"])
+        assert pretrain_run["global_batch"] == 32
+        assert pretrain_run["n_data_shards"] == 8
+
+    def test_checkpoints_on_disk(self, pretrain_run):
+        entries = sorted(os.listdir(pretrain_run["save_dir"]))
+        assert "epoch=1-cifar10" in entries
+        assert "epoch=2-cifar10" in entries
+
+    def test_resume_continues_from_checkpoint(self, pretrain_run, tmp_path):
+        """Re-running with resume=true and more epochs continues, not restarts."""
+        save_dir = pretrain_run["save_dir"]
+        summary = pretrain_main(
+            SYNTH
+            + [
+                "parameter.epochs=3",
+                "parameter.warmup_epochs=1",
+                "experiment.save_model_epoch=3",
+                "experiment.resume=true",
+                f"experiment.save_dir={save_dir}",
+            ]
+        )
+        # resumed at step 4 (epoch 3 only): 2 more steps
+        assert summary["steps"] == 6
+
+
+class TestEval:
+    def test_centroid(self, pretrain_run, tmp_path):
+        out = str(tmp_path / "eval-centroid")
+        results = eval_main(
+            SYNTH
+            + [
+                "parameter.classifier=centroid",
+                f"experiment.target_dir={pretrain_run['save_dir']}",
+                f"experiment.save_dir={out}",
+            ]
+        )
+        assert len(results) == 3  # epochs 1, 2, and the resume run's epoch 3
+        for metrics in results.values():
+            assert 0.0 <= metrics["val_acc"] <= 1.0
+            assert metrics["val_acc"] <= metrics["val_top_5_acc"] <= 1.0
+        with open(os.path.join(out, "results.json")) as f:
+            assert json.load(f).keys() == results.keys()
+
+    @pytest.mark.parametrize("kind", ["linear", "nonlinear"])
+    def test_learnable(self, pretrain_run, tmp_path, kind):
+        out = str(tmp_path / f"eval-{kind}")
+        results = eval_main(
+            SYNTH
+            + [
+                f"parameter.classifier={kind}",
+                "parameter.epochs=2",
+                f"experiment.target_dir={pretrain_run['save_dir']}",
+                f"experiment.save_dir={out}",
+            ]
+        )
+        for metrics in results.values():
+            assert len(metrics["val_accuracies"]) == 2
+            assert metrics["highest_val_acc"] == max(metrics["val_accuracies"])
+            assert all(np.isfinite(v) for v in metrics["val_losses"])
+
+    def test_full_encoder_features(self, pretrain_run, tmp_path):
+        out = str(tmp_path / "eval-full")
+        results = eval_main(
+            SYNTH
+            + [
+                "parameter.classifier=centroid",
+                "parameter.use_full_encoder=true",
+                f"experiment.target_dir={pretrain_run['save_dir']}",
+                f"experiment.save_dir={out}",
+            ]
+        )
+        assert results
+
+
+class TestSaveFeatures:
+    def test_npy_exports(self, pretrain_run, tmp_path, monkeypatch):
+        import simclr_tpu.save_features as sf
+
+        monkeypatch.setattr(sf, "NUM_AUGMENTATIONS", 2)
+        monkeypatch.setattr(sf, "SNAPSHOT_PASSES", (1, 2))
+        out = str(tmp_path / "features")
+        written = save_features_main(
+            SYNTH
+            + [
+                f"experiment.target_dir={pretrain_run['save_dir']}",
+                f"experiment.save_dir={out}",
+            ]
+        )
+        assert written
+        train_feats = [p for p in written if p.endswith(".train.features.npy")]
+        X = np.load(train_feats[0])
+        assert X.shape == (64, 512)  # resnet18 feature dim
+        aug1 = [p for p in written if ".train.aug-1." in p][0]
+        aug2 = [p for p in written if ".train.aug-2." in p][0]
+        a1, a2 = np.load(aug1), np.load(aug2)
+        assert a1.shape == X.shape
+        # averaging over different augmentations must change the features
+        assert np.abs(a1 - a2).max() > 0
+
+
+class TestSupervised:
+    def test_one_epoch(self, tmp_path):
+        save_dir = str(tmp_path / "supervised")
+        summary = supervised_main(
+            SYNTH
+            + [
+                "parameter.epochs=1",
+                "parameter.warmup_epochs=0",
+                f"experiment.save_dir={save_dir}",
+            ]
+        )
+        assert summary["steps"] == 2
+        assert summary["best_epoch"] == 1
+        assert os.path.isdir(summary["best_path"])
+        assert 0.0 <= summary["history"][0]["val_acc"] <= 1.0
+
+    def test_best_only_policy(self, tmp_path):
+        save_dir = str(tmp_path / "supervised-best")
+        summary = supervised_main(
+            SYNTH
+            + [
+                "parameter.epochs=2",
+                "parameter.warmup_epochs=0",
+                "parameter.metric=loss",
+                f"experiment.save_dir={save_dir}",
+            ]
+        )
+        # only ONE checkpoint dir remains (previous best deleted)
+        ckpts = [d for d in os.listdir(save_dir) if d.startswith("epoch=")]
+        assert len(ckpts) == 1
+        assert summary["metric"] == "loss"

@@ -11,8 +11,9 @@ function that runs ON DEVICE as part of the train step. The host feeds raw
 uint8 batches; the two stochastic views are produced by the same XLA program
 that consumes them, so there is no per-worker CPU bottleneck and no H2D
 traffic beyond the raw images. All shapes are static: the data-dependent
-crop/resize is expressed with ``jax.image.scale_and_translate`` (static
-output shape, traced scale/translation), and the random-order color jitter
+crop/resize is expressed as two (out, in) bilinear sampling matrices applied
+as matmuls, with traced crop-box coordinates and coordinates clamped inside
+the box (see :func:`random_resized_crop`), and the random-order color jitter
 uses ``lax.switch`` over op indices.
 
 Distribution parity with torchvision (the likeliest silent-accuracy-gap
@@ -31,18 +32,22 @@ Images are float32 in [0,1], NHWC.
 from __future__ import annotations
 
 import itertools
+import math
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # torchvision RandomResizedCrop defaults (scale, ratio) and attempt count.
+# Host-side constants (numpy/math, not jnp) so importing this module never
+# initializes a JAX backend.
 _CROP_SCALE = (0.08, 1.0)
-_CROP_LOG_RATIO = (jnp.log(3.0 / 4.0), jnp.log(4.0 / 3.0))
+_CROP_LOG_RATIO = (math.log(3.0 / 4.0), math.log(4.0 / 3.0))
 _CROP_ATTEMPTS = 10
 
-_GRAY_WEIGHTS = jnp.array([0.299, 0.587, 0.114], dtype=jnp.float32)
+_GRAY_WEIGHTS = np.array([0.299, 0.587, 0.114], dtype=np.float32)
 
 
 def to_float(image: jnp.ndarray) -> jnp.ndarray:
@@ -121,34 +126,51 @@ def _sample_crop_box(key: jax.Array, height: int, width: int):
     return top, left, h_out, w_out
 
 
+def _axis_resize_weights(
+    origin: jnp.ndarray, size: jnp.ndarray, out_size: int, in_size: int
+) -> jnp.ndarray:
+    """(out_size, in_size) bilinear sampling matrix for one axis.
+
+    Sample centers follow the half-pixel convention torch/PIL use
+    (``src = origin + (dst + 0.5) * size/out - 0.5``) and are CLAMPED to the
+    crop box, so border pixels replicate the box edge exactly as a
+    crop-then-resize does — never bleeding into source pixels outside the
+    sampled box.
+    """
+    centers = origin + (jnp.arange(out_size, dtype=jnp.float32) + 0.5) * (
+        size / out_size
+    ) - 0.5
+    centers = jnp.clip(centers, origin, origin + size - 1.0)
+    i0 = jnp.floor(centers)
+    frac = centers - i0
+    i0 = jnp.clip(i0.astype(jnp.int32), 0, in_size - 1)
+    i1 = jnp.clip(i0 + 1, 0, in_size - 1)
+    rows = jnp.arange(out_size)
+    weights = jnp.zeros((out_size, in_size), jnp.float32)
+    weights = weights.at[rows, i0].add(1.0 - frac)
+    weights = weights.at[rows, i1].add(frac)
+    return weights
+
+
 def random_resized_crop(
     key: jax.Array, image: jnp.ndarray, out_size: int = 32
 ) -> jnp.ndarray:
     """Crop a random box and resize to (out_size, out_size) bilinearly.
 
-    The dynamic-size crop + static-size resize is one
-    ``jax.image.scale_and_translate`` call (static output shape, traced
-    affine), which XLA lowers to a dense gather/matmul — no dynamic shapes.
-    CIFAR crops are never larger than the source, so plain bilinear matches
-    PIL's upsampling path (antialiasing only differs when downscaling).
+    The dynamic-size crop + static-size resize is expressed as two static
+    (out, H)/(out, W) sampling matrices applied as matmuls (MXU-friendly, no
+    dynamic shapes), with sample coordinates clamped inside the crop box —
+    matching crop-then-resize edge behavior. Remaining documented deviation
+    from torchvision: PIL antialiases when downscaling; this is plain
+    bilinear.
     """
     height, width = image.shape[0], image.shape[1]
     top, left, crop_h, crop_w = _sample_crop_box(key, height, width)
 
-    scale = jnp.array([out_size / crop_h, out_size / crop_w], dtype=jnp.float32)
-    # output pixel o maps to input  o/scale + (-translation)/scale... in
-    # scale_and_translate terms: in_coord = (out_coord - translation) / scale,
-    # so translation = -crop_origin * scale.
-    translation = -jnp.array([top, left], dtype=jnp.float32) * scale
-    return jax.image.scale_and_translate(
-        image.astype(jnp.float32),
-        shape=(out_size, out_size, image.shape[2]),
-        spatial_dims=(0, 1),
-        scale=scale,
-        translation=translation,
-        method="bilinear",
-        antialias=False,
-    )
+    w_rows = _axis_resize_weights(top, crop_h, out_size, height)      # (out, H)
+    w_cols = _axis_resize_weights(left, crop_w, out_size, width)      # (out, W)
+    img = image.astype(jnp.float32)
+    return jnp.einsum("oh,hwc,pw->opc", w_rows, img, w_cols)
 
 
 # ---------------------------------------------------------------------------
@@ -214,7 +236,7 @@ def adjust_hue(image: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(jnp.stack([r_out, g_out, b_out], axis=-1), 0.0, 1.0)
 
 
-_JITTER_PERMS = jnp.array(list(itertools.permutations(range(4))), dtype=jnp.int32)
+_JITTER_PERMS = np.array(list(itertools.permutations(range(4))), dtype=np.int32)
 
 
 def color_jitter(
@@ -235,7 +257,7 @@ def color_jitter(
         lambda img: adjust_saturation(img, f_s),
         lambda img: adjust_hue(img, f_h),
     ]
-    perm = _JITTER_PERMS[
+    perm = jnp.asarray(_JITTER_PERMS)[
         jax.random.randint(k_perm, (), 0, _JITTER_PERMS.shape[0])
     ]
     for slot in range(4):

@@ -107,10 +107,15 @@ def synthetic_dataset(
     if size is None:
         size = _TRAIN_SIZES[name] if split == "train" else _TEST_SIZES[name]
     rng = np.random.default_rng(seed + (0 if split == "train" else 1))
-    prototypes = rng.integers(0, 256, size=(num_classes, 32, 32, 3))
+    # float32/uint8 throughout: the default 50k split would otherwise build
+    # multi-GB int64/float64 temporaries on the small smoke-test hosts this
+    # fallback exists for
+    prototypes = rng.integers(0, 256, size=(num_classes, 32, 32, 3)).astype(np.float32)
     labels = np.arange(size, dtype=np.int32) % num_classes
-    noise = rng.normal(0.0, 24.0, size=(size, 32, 32, 3))
-    images = np.clip(prototypes[labels] + noise, 0, 255).astype(np.uint8)
+    noise = rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
+    noise *= 24.0
+    noise += prototypes[labels]
+    images = np.clip(noise, 0, 255, out=noise).astype(np.uint8)
     return Dataset(images=images, labels=labels, name=name, split=split, synthetic=True)
 
 

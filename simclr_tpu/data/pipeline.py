@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from simclr_tpu.data.cifar import Dataset
+from simclr_tpu.native.lib import gather_rows2
 
 
 def epoch_permutation(num_samples: int, seed: int, epoch: int) -> np.ndarray:
@@ -66,6 +67,20 @@ class EpochIterator:
             raise ValueError(
                 f"dataset of {n} samples smaller than global batch {global_batch}"
             )
+        if not drop_last and sharding is not None and n % global_batch:
+            raise ValueError(
+                f"drop_last=False with a device sharding requires the dataset "
+                f"size ({n}) to divide the global batch ({global_batch}): a "
+                f"partial final batch cannot be laid out over the mesh (pad "
+                f"the tail on the host instead, as supervised.py does)"
+            )
+        n_proc = jax.process_count()
+        if global_batch % n_proc:
+            raise ValueError(
+                f"global batch {global_batch} must be divisible by the "
+                f"process count {n_proc}; otherwise hosts would silently "
+                f"drop {global_batch % n_proc} rows per step"
+            )
 
     def _order(self, epoch: int) -> np.ndarray:
         if self.shuffle:
@@ -81,10 +96,11 @@ class EpochIterator:
             # each host materializes only its contiguous row block
             per_host = len(idx) // n_proc if n_proc > 1 else len(idx)
             local_idx = idx[proc * per_host : (proc + 1) * per_host]
-            batch = {
-                "image": self.dataset.images[local_idx],
-                "label": self.dataset.labels[local_idx],
-            }
+            # native multithreaded row gather (numpy-take fallback inside)
+            images, labels = gather_rows2(
+                self.dataset.images, self.dataset.labels, local_idx
+            )
+            batch = {"image": images, "label": labels}
             if self.sharding is not None:
                 batch = {
                     k: self._to_device(v, k) for k, v in batch.items()

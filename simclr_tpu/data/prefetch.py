@@ -1,0 +1,79 @@
+"""Background prefetcher: overlap batch assembly + H2D with the device step.
+
+The capability the reference buys with ``DataLoader(num_workers=8,
+pin_memory=True)`` (``/root/reference/main.py:170-173``) — keeping the
+accelerator fed while the CPU prepares the next batch — reshaped for SPMD:
+one daemon thread per process assembles upcoming batches (native C++ row
+gather, ``simclr_tpu/native``) and ``device_put``s them so the transfer
+overlaps the in-flight XLA step. Queue depth 2 is enough: JAX dispatch is
+async, so the host loop runs ahead of the device by design; the prefetcher
+just keeps gather+transfer off the critical path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections.abc import Iterator
+from typing import Any
+
+_SENTINEL = object()
+
+
+class Prefetcher:
+    """Wraps any batch iterator; yields the same batches, prefetched.
+
+    Exceptions in the worker are re-raised in the consumer. Always used as a
+    context manager or fully drained; ``close()`` stops early.
+    """
+
+    def __init__(self, iterator: Iterator[Any], depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._error: BaseException | None = None
+        self._stop = threading.Event()
+
+        def worker():
+            try:
+                for item in iterator:
+                    if self._stop.is_set():
+                        return
+                    self._q.put(item)
+            except BaseException as e:  # noqa: BLE001 - relayed to consumer
+                self._error = e
+            finally:
+                self._q.put(_SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._thread.join()
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so the worker unblocks from a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def prefetch(iterator: Iterator[Any], depth: int = 2) -> Prefetcher:
+    return Prefetcher(iterator, depth=depth)

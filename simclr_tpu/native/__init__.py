@@ -1,0 +1,17 @@
+"""Native (C++) host-runtime components with pure-NumPy fallbacks.
+
+The reference's native data-path surface is PyTorch's DataLoader worker pool
+(C++ core + worker processes, ``/root/reference/main.py:169-173``). On a TPU
+host under SPMD there is one process, so the equivalent capability is (a) a
+multithreaded C++ batch gather (``gather.cpp``) and (b) a background
+prefetcher that overlaps batch assembly + H2D transfer with the device step
+(``simclr_tpu/data/prefetch.py``).
+
+Everything here degrades gracefully: if the shared library is missing and
+cannot be built (no compiler), callers fall back to NumPy fancy indexing —
+identical results, lower throughput.
+"""
+
+from simclr_tpu.native.lib import gather_rows, native_available
+
+__all__ = ["gather_rows", "native_available"]

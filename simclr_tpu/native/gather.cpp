@@ -1,0 +1,86 @@
+// Host-side batch assembly: multithreaded row gather.
+//
+// TPU-native replacement for the capability the reference gets from
+// PyTorch's DataLoader worker processes (/root/reference/main.py:169-173,
+// num_workers=8 + pin_memory): assembling a batch = gathering N rows of a
+// large contiguous uint8 array by shuffled indices into one dense buffer
+// that can be DMA'd to the device. Worker *processes* are the wrong shape on
+// TPU hosts (one process per host under SPMD); what's actually needed is a
+// memory-bandwidth-bound scatter/gather, which this does with a small thread
+// pool over plain memcpy — no Python object overhead, no pickling, no IPC.
+//
+// Exposed as a C ABI for ctypes; built by simclr_tpu/native/build.py.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows of `src` (each `row_bytes` long) at `idx[0..n_idx)` into `dst`.
+// Rows land contiguously in dst in index order. Threads split the index
+// range; each thread's slice is contiguous in dst, so writes never overlap.
+void gather_rows(const uint8_t* src, const int64_t* idx, uint8_t* dst,
+                 int64_t n_idx, int64_t row_bytes, int32_t n_threads) {
+  if (n_idx <= 0) return;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_idx) n_threads = static_cast<int32_t>(n_idx);
+
+  auto worker = [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    }
+  };
+
+  if (n_threads == 1) {
+    worker(0, n_idx);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t begin = t * chunk;
+    int64_t end = begin + chunk < n_idx ? begin + chunk : n_idx;
+    if (begin >= end) break;
+    threads.emplace_back(worker, begin, end);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Gather into TWO destination buffers at once (image rows + label rows for
+// the same indices) — one pass over the index list, one thread pool.
+void gather_rows2(const uint8_t* src_a, int64_t row_bytes_a, uint8_t* dst_a,
+                  const uint8_t* src_b, int64_t row_bytes_b, uint8_t* dst_b,
+                  const int64_t* idx, int64_t n_idx, int32_t n_threads) {
+  if (n_idx <= 0) return;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_idx) n_threads = static_cast<int32_t>(n_idx);
+
+  auto worker = [=](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      std::memcpy(dst_a + i * row_bytes_a, src_a + idx[i] * row_bytes_a,
+                  row_bytes_a);
+      std::memcpy(dst_b + i * row_bytes_b, src_b + idx[i] * row_bytes_b,
+                  row_bytes_b);
+    }
+  };
+
+  if (n_threads == 1) {
+    worker(0, n_idx);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t begin = t * chunk;
+    int64_t end = begin + chunk < n_idx ? begin + chunk : n_idx;
+    if (begin >= end) break;
+    threads.emplace_back(worker, begin, end);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

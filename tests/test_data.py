@@ -118,16 +118,41 @@ class TestRandomOps:
         assert out.min() >= -1e-4 and out.max() <= 1.0 + 1e-4
 
     def test_crop_identity_when_box_is_full_image(self):
-        # scale_and_translate with crop == full image must reproduce it
-        from simclr_tpu.data import augment as aug
+        # a full-image crop box must reproduce the image exactly
+        from simclr_tpu.data.augment import _axis_resize_weights
 
         img = _image()
-        scale = jnp.array([1.0, 1.0])
-        out = jax.image.scale_and_translate(
-            img, (32, 32, 3), (0, 1), scale, jnp.zeros(2), "bilinear", False
-        )
+        w = _axis_resize_weights(jnp.float32(0.0), jnp.float32(32.0), 32, 32)
+        out = jnp.einsum("oh,hwc,pw->opc", w, img, w)
         np.testing.assert_allclose(out, img, atol=1e-5)
-        del aug
+
+    def test_crop_matches_explicit_crop_then_resize(self):
+        # interior AND border pixels must equal numpy crop-then-bilinear-resize
+        from simclr_tpu.data.augment import _axis_resize_weights
+
+        img = np.asarray(_image())
+        top, left, ch, cw = 5, 9, 13, 17
+        w_r = np.asarray(
+            _axis_resize_weights(jnp.float32(top), jnp.float32(ch), 32, 32)
+        )
+        w_c = np.asarray(
+            _axis_resize_weights(jnp.float32(left), jnp.float32(cw), 32, 32)
+        )
+        # sampling matrices must read ONLY inside the crop box
+        assert np.all(w_r[:, :top] == 0) and np.all(w_r[:, top + ch :] == 0)
+        assert np.all(w_c[:, :left] == 0) and np.all(w_c[:, left + cw :] == 0)
+
+        # reference: crop with numpy, then the same clamped bilinear resize
+        box = img[top : top + ch, left : left + cw]
+        w_r_box = np.asarray(
+            _axis_resize_weights(jnp.float32(0.0), jnp.float32(ch), 32, ch)
+        )
+        w_c_box = np.asarray(
+            _axis_resize_weights(jnp.float32(0.0), jnp.float32(cw), 32, cw)
+        )
+        expected = np.einsum("oh,hwc,pw->opc", w_r_box, box, w_c_box)
+        got = np.einsum("oh,hwc,pw->opc", w_r, img, w_c)
+        np.testing.assert_allclose(got, expected, atol=1e-5)
 
     def test_crop_upsamples_subregion(self):
         # a gradient image: crops must stay within original value range

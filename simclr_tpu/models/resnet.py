@@ -74,10 +74,16 @@ class BasicBlock(nn.Module):
         norm = partial(self.norm, use_running_average=not train, dtype=self.dtype)
 
         residual = x
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        # explicit symmetric padding: XLA's SAME pads (0,1) at stride 2,
+        # which would misalign weights imported from torch checkpoints
+        # (utils/torch_import.py); (1,1) matches torch conv padding=1
+        y = conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=((1, 1), (1, 1)),
+        )(x)
         y = norm()(y)
         y = nn.relu(y)
-        y = conv(self.filters, (3, 3))(y)
+        y = conv(self.filters, (3, 3), padding=((1, 1), (1, 1)))(y)
         y = norm()(y)
 
         if residual.shape != y.shape:
@@ -111,7 +117,10 @@ class BottleneckBlock(nn.Module):
         y = conv(self.filters, (1, 1))(x)
         y = norm()(y)
         y = nn.relu(y)
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=((1, 1), (1, 1)),  # torch-aligned (see BasicBlock)
+        )(y)
         y = norm()(y)
         y = nn.relu(y)
         y = conv(self.filters * 4, (1, 1))(y)
@@ -154,6 +163,7 @@ class ResNetEncoder(nn.Module):
                 64,
                 (3, 3),
                 strides=(1, 1),
+                padding=((1, 1), (1, 1)),
                 use_bias=False,
                 dtype=self.dtype,
                 param_dtype=jnp.float32,
@@ -167,6 +177,7 @@ class ResNetEncoder(nn.Module):
                 64,
                 (7, 7),
                 strides=(2, 2),
+                padding=((3, 3), (3, 3)),
                 use_bias=False,
                 dtype=self.dtype,
                 param_dtype=jnp.float32,
@@ -175,7 +186,7 @@ class ResNetEncoder(nn.Module):
             )(x)
             x = norm(use_running_average=not train, dtype=self.dtype)(x)
             x = nn.relu(x)
-            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
         for stage, num_blocks in enumerate(stage_sizes):
             for block in range(num_blocks):

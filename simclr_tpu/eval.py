@@ -67,6 +67,20 @@ def load_model_variables(ckpt_path: str) -> dict:
     return {"params": raw["params"], "batch_stats": raw.get("batch_stats", {})}
 
 
+def _fetch(x: jax.Array) -> np.ndarray:
+    """Device array -> host numpy, multi-host safe.
+
+    Under multi-host SPMD the encode output is sharded over chips this
+    process cannot address; ``process_allgather`` assembles the full array on
+    every host (features are small: N x 512 floats).
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def extract_features(
     model, variables, images: np.ndarray, mesh, batch: int, use_full_encoder: bool
 ) -> np.ndarray:
@@ -81,7 +95,7 @@ def extract_features(
     outs = []
     for i in range(steps):
         chunk = jax.device_put(images[i * batch : (i + 1) * batch], sharding)
-        outs.append(np.asarray(encode(variables["params"], variables["batch_stats"], chunk)))
+        outs.append(_fetch(encode(variables["params"], variables["batch_stats"], chunk)))
     return np.concatenate(outs)[:n]
 
 
@@ -316,9 +330,11 @@ def run_eval(cfg: Config) -> dict:
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    maybe_initialize_multihost()
     cfg = load_config("eval", overrides=list(sys.argv[1:] if argv is None else argv))
     return run_eval(cfg)
 

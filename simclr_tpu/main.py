@@ -132,6 +132,8 @@ def run_pretrain(cfg: Config) -> dict:
         temperature=float(cfg.parameter.temperature),
         strength=float(cfg.experiment.strength),
         negatives=str(cfg.select("loss.negatives", "global")),
+        fused=bool(cfg.select("loss.fused", False)),
+        forward_mode=str(cfg.select("model.forward_mode", "two_pass")),
     )
     data_shard = batch_sharding(mesh)
     iterator = EpochIterator(
@@ -186,9 +188,11 @@ def run_pretrain(cfg: Config) -> dict:
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    maybe_initialize_multihost()
     cfg = load_config("config", overrides=list(sys.argv[1:] if argv is None else argv))
     return run_pretrain(cfg)
 

@@ -41,18 +41,35 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_tile(m: int, preferred: int = 256) -> int:
-    for t in (preferred, 128, 64, 32, 16, 8, 4, 2, 1):
-        if t <= m and m % t == 0:
-            return t
-    return 1
+def _tile_and_pad(m: int) -> tuple[int, int]:
+    """(tile, padded_m): hardware-aligned tiling for any batch size.
+
+    Rows are padded up to the tile so block shapes never fall below the TPU
+    (8, 128) native tile; padded candidate columns are masked to -inf inside
+    the kernels (flash-kernel style), so results are exact for the real m.
+    """
+    if m >= 128:
+        tile = 128
+    else:
+        tile = -(-m // 8) * 8  # next multiple of 8: one tile covers everything
+    return tile, -(-m // tile) * tile
+
+
+def _pad_rows(x: jnp.ndarray, m_pad: int, fill: float = 0.0) -> jnp.ndarray:
+    m = x.shape[0]
+    if m == m_pad:
+        return x
+    pad_widths = [(0, m_pad - m)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad_widths, constant_values=fill)
 
 
 # ---------------------------------------------------------------------------
 # forward: masked row logsumexp of  z @ z.T / tau
 # ---------------------------------------------------------------------------
 
-def _lse_kernel(z_row_ref, z_col_ref, lse_ref, m_scr, s_scr, *, inv_temp, tm, tn):
+def _lse_kernel(
+    z_row_ref, z_col_ref, lse_ref, m_scr, s_scr, *, inv_temp, tm, tn, m_real
+):
     i = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -62,7 +79,8 @@ def _lse_kernel(z_row_ref, z_col_ref, lse_ref, m_scr, s_scr, *, inv_temp, tm, tn
     )
     rows = jax.lax.broadcasted_iota(jnp.int32, (tm, tn), 0) + i * tm
     cols = jax.lax.broadcasted_iota(jnp.int32, (tm, tn), 1) + j * tn
-    sim = jnp.where(rows == cols, _NEG_INF, sim)
+    # mask self-similarity AND padded candidate columns
+    sim = jnp.where((rows == cols) | (cols >= m_real), _NEG_INF, sim)
 
     @pl.when(j == 0)
     def _():
@@ -83,24 +101,24 @@ def _lse_kernel(z_row_ref, z_col_ref, lse_ref, m_scr, s_scr, *, inv_temp, tm, tn
 
 def _masked_lse_fwd_impl(zn: jnp.ndarray, temperature: float) -> jnp.ndarray:
     m, d = zn.shape
-    tm = _pick_tile(m)
-    tn = _pick_tile(m)
+    tile, m_pad = _tile_and_pad(m)
+    zp = _pad_rows(zn, m_pad)
     kernel = functools.partial(
-        _lse_kernel, inv_temp=1.0 / temperature, tm=tm, tn=tn
+        _lse_kernel, inv_temp=1.0 / temperature, tm=tile, tn=tile, m_real=m
     )
     lse = pl.pallas_call(
         kernel,
-        grid=(m // tm, m // tn),
+        grid=(m_pad // tile, m_pad // tile),
         in_specs=[
-            pl.BlockSpec((tm, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((tn, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((tile, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile, d), lambda i, j: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((tm, 1), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((m, 1), jnp.float32),
-        scratch_shapes=[_vmem((tm, 1)), _vmem((tm, 1))],
+        out_specs=pl.BlockSpec((tile, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, 1), jnp.float32),
+        scratch_shapes=[_vmem((tile, 1)), _vmem((tile, 1))],
         interpret=_interpret(),
-    )(zn, zn)
-    return lse[:, 0]
+    )(zp, zp)
+    return lse[:m, 0]
 
 
 def _vmem(shape):
@@ -114,7 +132,8 @@ def _vmem(shape):
 # ---------------------------------------------------------------------------
 
 def _grad_kernel(
-    z_out_ref, z_in_ref, lse_ref, g_ref, acc_ref, *, inv_temp, tm, tn, transpose
+    z_out_ref, z_in_ref, lse_ref, g_ref, acc_ref, *, inv_temp, tm, tn, m_real,
+    transpose,
 ):
     """Accumulate one output row-tile of the gradient.
 
@@ -132,7 +151,9 @@ def _grad_kernel(
     )
     rows = jax.lax.broadcasted_iota(jnp.int32, (tm, tn), 0) + o * tm
     cols = jax.lax.broadcasted_iota(jnp.int32, (tm, tn), 1) + k * tn
-    sim = jnp.where(rows == cols, _NEG_INF, sim)
+    # mask the diagonal and padded reduction-axis entries (their lse/g pads
+    # are finite zeros, so exp(sim - lse) would otherwise contribute)
+    sim = jnp.where((rows == cols) | (cols >= m_real), _NEG_INF, sim)
 
     if transpose:
         # lse/g belong to the reduction (anchor) axis -> broadcast over cols
@@ -152,40 +173,37 @@ def _masked_lse_bwd_impl(
     zn: jnp.ndarray, lse: jnp.ndarray, g: jnp.ndarray, temperature: float
 ) -> jnp.ndarray:
     m, d = zn.shape
-    tm = _pick_tile(m)
-    tn = _pick_tile(m)
-    lse2 = lse.reshape(m, 1)
-    g2 = g.astype(jnp.float32).reshape(m, 1)
+    tile, m_pad = _tile_and_pad(m)
+    zp = _pad_rows(zn, m_pad)
+    lse2 = _pad_rows(lse.reshape(m, 1), m_pad)           # pad value 0: finite
+    g2 = _pad_rows(g.astype(jnp.float32).reshape(m, 1), m_pad)
 
     def call(transpose):
         kernel = functools.partial(
-            _grad_kernel, inv_temp=1.0 / temperature, tm=tm, tn=tn,
-            transpose=transpose,
+            _grad_kernel, inv_temp=1.0 / temperature, tm=tile, tn=tile,
+            m_real=m, transpose=transpose,
         )
         # anchor-grad pass: lse/g indexed by output tile (o);
         # candidate-grad pass: lse/g indexed by reduction tile (k)
         stat_index = (lambda o, k: (k, 0)) if transpose else (lambda o, k: (o, 0))
-        stat_block = tn if transpose else tm
         return pl.pallas_call(
             kernel,
-            grid=(m // tm, m // tn),
+            grid=(m_pad // tile, m_pad // tile),
             in_specs=[
-                pl.BlockSpec((tm, d), lambda o, k: (o, 0)),
-                pl.BlockSpec((tn, d), lambda o, k: (k, 0)),
-                pl.BlockSpec((stat_block, 1), stat_index),
-                pl.BlockSpec((stat_block, 1), stat_index),
+                pl.BlockSpec((tile, d), lambda o, k: (o, 0)),
+                pl.BlockSpec((tile, d), lambda o, k: (k, 0)),
+                pl.BlockSpec((tile, 1), stat_index),
+                pl.BlockSpec((tile, 1), stat_index),
             ],
-            out_specs=pl.BlockSpec((tm, d), lambda o, k: (o, 0)),
-            out_shape=jax.ShapeDtypeStruct((m, d), jnp.float32),
-            scratch_shapes=[],
-            input_output_aliases={},
+            out_specs=pl.BlockSpec((tile, d), lambda o, k: (o, 0)),
+            out_shape=jax.ShapeDtypeStruct((m_pad, d), jnp.float32),
             interpret=_interpret(),
-        )(zn, zn, lse2, g2)
+        )(zp, zp, lse2, g2)
 
     # acc_ref IS the output block (revisited across k); no scratch needed
     danchor = call(transpose=False)
     dcandidate = call(transpose=True)
-    return (danchor + dcandidate) / temperature
+    return (danchor[:m] + dcandidate[:m]) / temperature
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

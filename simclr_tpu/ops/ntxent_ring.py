@@ -52,24 +52,27 @@ def ntxent_loss_ring(
     # positive similarities: partner view, same shard (rows i <-> i+B)
     pos = jnp.sum(anchors * jnp.roll(anchors, n_local, axis=0), axis=-1) / temperature
 
-    # ring permutation: each shard passes its block to the next shard
+    # ring permutation: each shard passes its block to the next shard.
+    # The local (self-masked) block is folded in before the ring spins, so
+    # exactly n_shards - 1 ppermutes happen — no wasted final rotation.
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    self_mask = jnp.eye(two_b, dtype=bool)
 
-    def ring_step(carry, step):
+    sim0 = (anchors @ anchors.T) / temperature  # own block, (2B, 2B)
+    sim0 = jnp.where(jnp.eye(two_b, dtype=bool), _NEG_INF, sim0)
+    m0 = sim0.max(axis=1)
+    s0 = jnp.exp(sim0 - m0[:, None]).sum(axis=1)
+
+    def ring_step(carry, _):
         block, m, s = carry  # block: (2B, d) visiting candidates
+        block = lax.ppermute(block, axis_name, perm)
         sim = (anchors @ block.T) / temperature  # (2B, 2B) one MXU tile chain
-        sim = jnp.where((step == 0) & self_mask, _NEG_INF, sim)
         # exact online logsumexp accumulation
         m_new = jnp.maximum(m, sim.max(axis=1))
         s = s * jnp.exp(m - m_new) + jnp.exp(sim - m_new[:, None]).sum(axis=1)
-        block = lax.ppermute(block, axis_name, perm)
         return (block, m_new, s), None
 
-    m0 = jnp.full((two_b,), _NEG_INF, dtype=jnp.float32)
-    s0 = jnp.zeros((two_b,), dtype=jnp.float32)
     (_, m, s), _ = lax.scan(
-        ring_step, (anchors, m0, s0), jnp.arange(n_shards)
+        ring_step, (anchors, m0, s0), None, length=n_shards - 1
     )
 
     per_anchor = (jnp.log(s) + m) - pos  # logsumexp - positive

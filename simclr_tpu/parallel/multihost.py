@@ -1,0 +1,67 @@
+"""Multi-host (DCN) runtime initialization.
+
+The reference's multi-host story is NCCL ``env://`` rendezvous driven by a
+vendored launcher exporting MASTER_ADDR/PORT/RANK per process
+(``/root/reference/launch.py:209-229``) — and is in fact broken multi-node
+because the global rank is taken from ``local_rank`` (SURVEY §2.5.4). The
+TPU-native shape: ONE process per host calls ``jax.distributed.initialize``
+once; afterwards ``jax.devices()`` spans every chip in the slice and the
+same SPMD program runs unchanged — collectives ride ICI within a host's
+chips and DCN across hosts, laid out by XLA from the mesh.
+
+On Cloud TPU slices ``jax.distributed.initialize()`` discovers coordinator,
+process count, and process id from the TPU metadata automatically; explicit
+values (or the standard ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
+/ ``JAX_PROCESS_ID`` env vars) are only needed off-cloud. This module wraps
+that in an idempotent, single-host-safe call used by every entry point.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from simclr_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_initialized = False
+
+
+def maybe_initialize_multihost() -> bool:
+    """Initialize the distributed runtime when configured; returns True when
+    running multi-host after the call.
+
+    Triggers when any standard JAX cluster variable is set, or on TPU
+    platforms where auto-discovery works. Safe to call repeatedly, and a
+    silent no-op for plain single-host CPU/GPU development runs.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+
+    env_configured = any(
+        os.environ.get(k)
+        for k in (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "JAX_NUM_PROCESSES",
+        )
+    )
+    on_tpu_slice = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "MEGASCALE_COORDINATOR_ADDRESS"
+    )
+    if not env_configured and not on_tpu_slice:
+        return False
+
+    try:
+        jax.distributed.initialize()
+        _initialized = True
+        logger.info(
+            "multihost: process %d/%d, %d global devices",
+            jax.process_index(), jax.process_count(), jax.device_count(),
+        )
+    except (RuntimeError, ValueError) as e:  # already initialized / refused
+        logger.warning("jax.distributed.initialize skipped: %s", e)
+    return jax.process_count() > 1

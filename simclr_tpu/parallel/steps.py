@@ -43,6 +43,7 @@ from simclr_tpu.ops.ntxent import (
     ntxent_loss_local_negatives,
     ntxent_loss_sharded_rows,
 )
+from simclr_tpu.ops.ntxent_pallas import ntxent_loss_fused
 from simclr_tpu.ops.ntxent_ring import ntxent_loss_ring
 from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from simclr_tpu.parallel.train_state import TrainState
@@ -79,6 +80,22 @@ def _apply_two_pass(model, params, batch_stats, v0, v1):
     return z0, z1, mut["batch_stats"]
 
 
+def _apply_concat(model, params, batch_stats, v0, v1):
+    """One forward over the concatenated 2B batch (performance option).
+
+    Halves kernel-launch/weight-streaming overhead by doubling every matmul's
+    batch, at the cost of BN statistics spanning both views jointly (the
+    google-research SimCLR formulation) instead of per-view — a documented
+    semantic deviation behind ``model.forward_mode=concat``.
+    """
+    n = v0.shape[0]
+    z, mut = model.apply(
+        {"params": params, "batch_stats": batch_stats},
+        jnp.concatenate([v0, v1], axis=0), train=True, mutable=["batch_stats"],
+    )
+    return z[:n], z[n:], mut["batch_stats"]
+
+
 def make_pretrain_step(
     model,
     tx: optax.GradientTransformation,
@@ -87,6 +104,8 @@ def make_pretrain_step(
     temperature: float = 0.5,
     strength: float = 0.5,
     negatives: str = "global",
+    fused: bool = False,
+    forward_mode: str = "two_pass",
     out_size: int = 32,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
     """Build the jitted contrastive train step.
@@ -94,17 +113,41 @@ def make_pretrain_step(
     Returned callable: ``(state, images_u8, rng) -> (state, metrics)`` with
     ``images`` the raw uint8 global batch sharded over the data axis. The
     model must be constructed with ``bn_cross_replica_axis=DATA_AXIS``.
+
+    ``fused=True`` routes the loss through the Pallas blockwise kernel
+    (``ops/ntxent_pallas.py``), which never materializes the similarity
+    matrix — worthwhile at large per-shard batches. Supported for ``local``
+    negatives on any mesh and for ``global``/``ring`` on a single-data-shard
+    mesh (where the local batch IS the global batch); the multi-shard global
+    candidate set keeps the XLA gather/ring paths.
     """
     if negatives not in ("global", "local", "ring"):
         raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
+    if forward_mode not in ("two_pass", "concat"):
+        raise ValueError(
+            f"forward_mode must be two_pass|concat, got {forward_mode!r}"
+        )
+    apply_views = _apply_two_pass if forward_mode == "two_pass" else _apply_concat
+    n_data_shards = mesh.shape[DATA_AXIS]
+    if fused and negatives != "local" and n_data_shards > 1:
+        raise ValueError(
+            "loss.fused currently supports negatives='local' on multi-shard "
+            "meshes, or any mode on a single-data-shard mesh"
+        )
 
     def local_step(state: TrainState, images: jnp.ndarray, rng: jax.Array):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
         v0, v1 = _augment_two_views(rng, images, strength, out_size)
 
         def loss_fn(params):
-            z0, z1, new_stats = _apply_two_pass(model, params, state.batch_stats, v0, v1)
-            if negatives == "global":
+            z0, z1, new_stats = apply_views(model, params, state.batch_stats, v0, v1)
+            if fused:
+                # per-shard fused kernel; pmean = reference DDP averaging
+                # (on a 1-shard mesh this IS the global objective)
+                loss = jax.lax.pmean(
+                    ntxent_loss_fused(z0, z1, temperature), DATA_AXIS
+                )
+            elif negatives == "global":
                 loss = ntxent_loss_sharded_rows(z0, z1, DATA_AXIS, temperature)
             elif negatives == "ring":
                 loss = ntxent_loss_ring(z0, z1, DATA_AXIS, temperature)

@@ -25,7 +25,7 @@ import numpy as np
 
 from simclr_tpu.config import Config, check_save_features_conf, load_config, resolve_save_dir
 from simclr_tpu.data.cifar import load_dataset
-from simclr_tpu.eval import extract_features, load_model_variables
+from simclr_tpu.eval import _fetch, extract_features, load_model_variables
 from simclr_tpu.models.contrastive import ContrastiveModel
 from simclr_tpu.parallel.mesh import (
     batch_sharding,
@@ -70,9 +70,7 @@ def augmented_features(
             chunk = jax.device_put(padded[i * batch : (i + 1) * batch], sharding)
             rng = jax.random.fold_in(jax.random.key(seed), t * steps + i)
             feats.append(
-                np.asarray(
-                    encode(variables["params"], variables["batch_stats"], chunk, rng)
-                )
+                _fetch(encode(variables["params"], variables["batch_stats"], chunk, rng))
             )
         pass_feats = np.concatenate(feats)[:n]
         mean = pass_feats if mean is None else mean + (pass_feats - mean) / t
@@ -149,9 +147,11 @@ def run_save_features(cfg: Config) -> list[str]:
 
 
 def main(argv: list[str] | None = None) -> list[str]:
+    from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    maybe_initialize_multihost()
     cfg = load_config("eval", overrides=list(sys.argv[1:] if argv is None else argv))
     return run_save_features(cfg)
 

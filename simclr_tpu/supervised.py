@@ -204,9 +204,11 @@ def run_supervised(cfg: Config) -> dict:
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    maybe_initialize_multihost()
     cfg = load_config(
         "supervised_config", overrides=list(sys.argv[1:] if argv is None else argv)
     )

@@ -1,0 +1,48 @@
+"""Shared test fixtures: tiny models with the real models' API surface."""
+
+import numpy as np
+from flax import linen as nn
+
+from simclr_tpu.parallel.mesh import DATA_AXIS
+
+
+class TinyContrastive(nn.Module):
+    """Minimal encoder+head with the ContrastiveModel API surface
+    (encode/__call__, params + batch_stats, cross-replica BN axis)."""
+
+    d: int = 8
+    hidden: int = 16
+    bn_cross_replica_axis: str | None = DATA_AXIS
+
+    def setup(self):
+        self.dense1 = nn.Dense(self.hidden, name="dense1")
+        self.bn = nn.BatchNorm(
+            momentum=0.9, axis_name=self.bn_cross_replica_axis, name="bn"
+        )
+        self.dense2 = nn.Dense(self.d, name="dense2")
+
+    def encode(self, x, train: bool = True):
+        y = self.dense1(x.reshape(x.shape[0], -1))
+        return nn.relu(self.bn(y, use_running_average=not train))
+
+    def __call__(self, x, train: bool = True):
+        return self.dense2(self.encode(x, train=train))
+
+
+class TinySupervised(nn.Module):
+    num_classes: int = 10
+    bn_cross_replica_axis: str | None = DATA_AXIS
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.Dense(16, name="dense1")(x.reshape(x.shape[0], -1))
+        y = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9,
+            axis_name=self.bn_cross_replica_axis, name="bn",
+        )(y)
+        return nn.Dense(self.num_classes, name="fc")(nn.relu(y))
+
+
+def random_images(n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, 32, 32, 3), dtype=np.uint8)

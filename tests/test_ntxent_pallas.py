@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from simclr_tpu.ops.ntxent import ntxent_loss
-from simclr_tpu.ops.ntxent_pallas import _pick_tile, ntxent_loss_fused
+from simclr_tpu.ops.ntxent_pallas import _tile_and_pad, ntxent_loss_fused
 
 
 def _views(n, d, seed=0):
@@ -21,12 +21,16 @@ def _views(n, d, seed=0):
     )
 
 
-class TestPickTile:
-    def test_divisors(self):
-        assert _pick_tile(1024) == 256
-        assert _pick_tile(64) == 64
-        assert _pick_tile(96) == 32
-        assert _pick_tile(6) == 2
+class TestTileAndPad:
+    def test_large_sizes_use_128_tiles(self):
+        assert _tile_and_pad(1024) == (128, 1024)
+        assert _tile_and_pad(204) == (128, 256)   # padded, never tiny tiles
+        assert _tile_and_pad(129) == (128, 256)
+
+    def test_small_sizes_single_aligned_tile(self):
+        assert _tile_and_pad(64) == (64, 64)
+        assert _tile_and_pad(6) == (8, 8)
+        assert _tile_and_pad(96) == (96, 96)
 
 
 class TestFusedForward:
@@ -73,3 +77,70 @@ class TestFusedGradient:
         z0, z1 = _views(8, 16, seed=4)
         g = jax.grad(lambda a: ntxent_loss_fused(a, z1, 0.5))(z0)
         assert float(jnp.abs(g).max()) > 0
+
+
+class TestFusedInTrainStep:
+    def test_fused_local_matches_plain_local(self):
+        """fused=True on the 8-shard mesh == negatives='local' loss."""
+        import numpy as np
+
+        from simclr_tpu.ops.lars import lars
+        from simclr_tpu.parallel.mesh import batch_sharding, create_mesh
+        from simclr_tpu.parallel.steps import make_pretrain_step
+        from simclr_tpu.parallel.train_state import create_train_state
+        from tests.helpers import TinyContrastive as Tiny
+
+        mesh = create_mesh()
+        model = Tiny()
+        tx = lars(0.1)
+        images = np.random.default_rng(0).integers(
+            0, 256, size=(32, 32, 32, 3), dtype=np.uint8
+        )
+        losses = {}
+        for fused in (False, True):
+            state = create_train_state(
+                model, tx, jax.random.key(0), jnp.zeros((32, 32, 32, 3))
+            )
+            step = make_pretrain_step(
+                model, tx, mesh, negatives="local", fused=fused
+            )
+            _, metrics = step(
+                state,
+                jax.device_put(images, batch_sharding(mesh)),
+                jax.random.key(1),
+            )
+            losses[fused] = float(metrics["loss"])
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+    def test_fused_global_multishard_rejected(self):
+        from simclr_tpu.ops.lars import lars
+        from simclr_tpu.parallel.mesh import create_mesh
+        from simclr_tpu.parallel.steps import make_pretrain_step
+
+        mesh = create_mesh()
+        with pytest.raises(ValueError, match="fused"):
+            make_pretrain_step(None, lars(0.1), mesh, negatives="global", fused=True)
+
+
+class TestMultihostNoop:
+    def test_single_host_is_noop(self):
+        from simclr_tpu.parallel.multihost import maybe_initialize_multihost
+
+        assert maybe_initialize_multihost() is False
+
+
+class TestFusedPaddingPath:
+    @pytest.mark.parametrize("n,d", [(7, 16), (51, 32), (102, 16)])
+    def test_odd_sizes_match_reference(self, n, d):
+        """Sizes that are not tile multiples exercise the pad+mask path."""
+        z0, z1 = _views(n, d, seed=7)
+        np.testing.assert_allclose(
+            float(ntxent_loss_fused(z0, z1, 0.5)),
+            float(ntxent_loss(z0, z1, 0.5, "mean")),
+            rtol=1e-5,
+        )
+        g_fused = jax.grad(lambda a: ntxent_loss_fused(a, z1, 0.5))(z0)
+        g_ref = jax.grad(lambda a: ntxent_loss(a, z1, 0.5, "mean"))(z0)
+        np.testing.assert_allclose(
+            np.asarray(g_fused), np.asarray(g_ref), rtol=1e-4, atol=1e-6
+        )

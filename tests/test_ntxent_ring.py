@@ -95,28 +95,11 @@ class TestRingInTrainStep:
     def test_pretrain_step_ring_negatives(self):
         """The full train step runs with negatives='ring' and matches the
         'global' objective's loss on the same inputs."""
-        from flax import linen as nn
-
         from simclr_tpu.ops.lars import lars
         from simclr_tpu.parallel.mesh import batch_sharding
         from simclr_tpu.parallel.steps import make_pretrain_step
         from simclr_tpu.parallel.train_state import create_train_state
-
-        class Tiny(nn.Module):
-            bn_cross_replica_axis: str | None = DATA_AXIS
-
-            def setup(self):
-                self.dense = nn.Dense(8, name="dense")
-                self.bn = nn.BatchNorm(
-                    momentum=0.9, axis_name=self.bn_cross_replica_axis, name="bn"
-                )
-
-            def encode(self, x, train=True):
-                y = self.dense(x.reshape(x.shape[0], -1))
-                return nn.relu(self.bn(y, use_running_average=not train))
-
-            def __call__(self, x, train=True):
-                return self.encode(x, train=train)
+        from tests.helpers import TinyContrastive as Tiny
 
         mesh = create_mesh()
         model = Tiny()

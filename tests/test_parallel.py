@@ -32,44 +32,7 @@ from simclr_tpu.parallel.steps import (
 from simclr_tpu.parallel.train_state import TrainState, create_train_state, param_count
 
 
-class TinyContrastive(nn.Module):
-    """Minimal encoder+head with the ContrastiveModel API surface."""
-
-    d: int = 8
-    bn_cross_replica_axis: str | None = None
-
-    def setup(self):
-        self.dense1 = nn.Dense(16, name="dense1")
-        self.bn = nn.BatchNorm(
-            momentum=0.9, axis_name=self.bn_cross_replica_axis, name="bn"
-        )
-        self.dense2 = nn.Dense(self.d, name="dense2")
-
-    def encode(self, x, train: bool = True):
-        y = self.dense1(x.reshape(x.shape[0], -1))
-        return nn.relu(self.bn(y, use_running_average=not train))
-
-    def __call__(self, x, train: bool = True):
-        return self.dense2(self.encode(x, train=train))
-
-
-class TinySupervised(nn.Module):
-    num_classes: int = 10
-    bn_cross_replica_axis: str | None = None
-
-    @nn.compact
-    def __call__(self, x, train: bool = True):
-        y = nn.Dense(16, name="dense1")(x.reshape(x.shape[0], -1))
-        y = nn.BatchNorm(
-            use_running_average=not train, momentum=0.9,
-            axis_name=self.bn_cross_replica_axis, name="bn",
-        )(y)
-        return nn.Dense(self.num_classes, name="fc")(nn.relu(y))
-
-
-def _images(n, seed=0):
-    rng = np.random.default_rng(seed)
-    return rng.integers(0, 256, size=(n, 32, 32, 3), dtype=np.uint8)
+from tests.helpers import TinyContrastive, TinySupervised, random_images as _images
 
 
 def _make_state(model, tx, batch=16):
@@ -252,3 +215,27 @@ class TestEncodeStep:
         n += 16 + 16  # bn scale/bias
         n += 16 * 8 + 8  # dense2
         assert param_count(state.params) == n
+
+
+class TestForwardMode:
+    def test_concat_runs_and_differs_from_two_pass(self):
+        mesh = create_mesh()
+        model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
+        tx = lars(0.1)
+        images = _images(16, seed=9)
+        losses = {}
+        for mode in ("two_pass", "concat"):
+            state = _make_state(model, tx)
+            step = make_pretrain_step(model, tx, mesh, forward_mode=mode)
+            state, metrics = step(
+                state, jax.device_put(images, batch_sharding(mesh)), jax.random.key(3)
+            )
+            losses[mode] = float(metrics["loss"])
+            assert np.isfinite(losses[mode])
+        # joint-BN vs per-view BN statistics -> small but nonzero difference
+        assert losses["two_pass"] != losses["concat"]
+
+    def test_bad_mode_rejected(self):
+        mesh = create_mesh()
+        with pytest.raises(ValueError, match="forward_mode"):
+            make_pretrain_step(None, lars(0.1), mesh, forward_mode="bogus")

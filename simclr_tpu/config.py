@@ -160,12 +160,16 @@ def _compose(conf_dir: str, config_name: str, group_choices: dict[str, str]) -> 
     loads ``<conf_dir>/<group>/<option>.yaml`` and merges it under the group
     key — unless the file opts into the root namespace with the marker key
     ``_global_: true`` (our spelling of Hydra's ``@package _global_``, which
-    every reference group file uses).
+    every reference group file uses). A group file may additionally set
+    ``_override_: true`` to merge AFTER the root config (the analogue of
+    placing ``_self_`` first in a Hydra defaults list), letting a recipe
+    file override root-level defaults like ``parameter.linear_schedule``.
     """
     root_path = os.path.join(conf_dir, f"{config_name}.yaml")
     root = _load_yaml_file(root_path)
     defaults = root.pop("defaults", [])
     merged: dict[str, Any] = {}
+    post_root: dict[str, Any] = {}
     for entry in defaults:
         if isinstance(entry, str):  # bare entry: another root-level file
             merged = _deep_merge(merged, _compose(conf_dir, entry, group_choices))
@@ -180,11 +184,14 @@ def _compose(conf_dir: str, config_name: str, group_choices: dict[str, str]) -> 
                 f"config group file not found: {path} (group {group!r}, option {option!r})"
             )
         group_data = _load_yaml_file(path)
-        if group_data.pop("_global_", False):
-            merged = _deep_merge(merged, group_data)
+        override = group_data.pop("_override_", False)
+        if not group_data.pop("_global_", False):
+            group_data = {group: group_data}
+        if override:
+            post_root = _deep_merge(post_root, group_data)
         else:
-            merged = _deep_merge(merged, {group: group_data})
-    return _deep_merge(merged, root)
+            merged = _deep_merge(merged, group_data)
+    return _deep_merge(_deep_merge(merged, root), post_root)
 
 
 def _parse_override_value(raw: str) -> Any:

@@ -52,6 +52,7 @@ from simclr_tpu.utils.checkpoint import (
     save_checkpoint,
 )
 from simclr_tpu.utils.logging import get_logger, is_logging_host
+from simclr_tpu.utils.profiling import StepTraceWindow
 from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
 
 logger = get_logger()
@@ -95,10 +96,17 @@ def run_pretrain(cfg: Config) -> dict:
     total_steps = epochs * steps_per_epoch
     warmup_steps = int(cfg.parameter.warmup_epochs) * steps_per_epoch
 
-    # Reference scales the base LR by the PER-DEVICE batch (lr_utils.py:11-15)
+    # reference parity scales the base LR by the PER-DEVICE batch
+    # (lr_utils.py:11-15); 'global' scales by the full mesh-wide batch (the
+    # paper's large-batch LARS recipe, conf/experiment/cifar10-large-batch)
+    lr_batch = (
+        global_batch
+        if str(cfg.select("parameter.lr_scale_batch", "per_device")) == "global"
+        else int(cfg.experiment.batches)
+    )
     lr0 = calculate_initial_lr(
         float(cfg.experiment.lr),
-        int(cfg.experiment.batches),
+        lr_batch,
         bool(cfg.parameter.linear_schedule),
     )
     schedule = warmup_cosine_schedule(lr0, total_steps, warmup_steps)
@@ -153,14 +161,25 @@ def run_pretrain(cfg: Config) -> dict:
     base_key = jax.random.key(seed + 1)
     metrics = {"loss": jnp.zeros(())}
     save_model_epoch = int(cfg.experiment.save_model_epoch)
+    # host-side step counter: reading state.step off-device every iteration
+    # would sync the host to the in-flight step and kill async dispatch
+    cur_step = (start_epoch - 1) * steps_per_epoch
+    # steady-state trace window: skips the first (compiling) step
+    tracer = StepTraceWindow(
+        cfg.select("experiment.profile_dir"),
+        start=cur_step + 2,
+        length=int(cfg.select("experiment.profile_steps", 10) or 10),
+        enabled=is_logging_host(),
+    )
     t_start = time.time()
     for epoch in range(start_epoch, epochs + 1):
         for batch in prefetch(iterator.batches(epoch)):
-            step_rng = jax.random.fold_in(base_key, int(state.step))
+            tracer.tick(cur_step, pending=metrics["loss"])
+            step_rng = jax.random.fold_in(base_key, cur_step)
             state, metrics = step_fn(state, batch["image"], step_rng)
+            cur_step += 1
         if is_logging_host():
             # one line per epoch, the reference's rank-0 log (main.py:124-127)
-            cur_step = int(state.step)
             lr_now = float(schedule(max(cur_step - 1, 0)))
             imgs_per_sec = (
                 (cur_step - (start_epoch - 1) * steps_per_epoch)
@@ -177,6 +196,7 @@ def run_pretrain(cfg: Config) -> dict:
             )
             save_checkpoint(path, state)
 
+    tracer.close(pending=metrics["loss"])
     return {
         "final_loss": float(metrics["loss"]),
         "steps": int(state.step),

@@ -39,6 +39,7 @@ from simclr_tpu.parallel.steps import make_supervised_eval_step, make_supervised
 from simclr_tpu.parallel.train_state import create_train_state, param_count
 from simclr_tpu.utils.checkpoint import checkpoint_name, delete_checkpoint, save_checkpoint
 from simclr_tpu.utils.logging import get_logger, is_logging_host
+from simclr_tpu.utils.profiling import StepTraceWindow
 from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
 
 logger = get_logger()
@@ -72,9 +73,17 @@ def run_supervised(cfg: Config) -> dict:
     total_steps = epochs * steps_per_epoch
     warmup_steps = int(cfg.parameter.warmup_epochs) * steps_per_epoch
 
+    # reference parity scales the base LR by the PER-DEVICE batch
+    # (lr_utils.py:11-15); 'global' scales by the full mesh-wide batch (the
+    # paper's large-batch LARS recipe, conf/experiment/cifar10-large-batch)
+    lr_batch = (
+        global_batch
+        if str(cfg.select("parameter.lr_scale_batch", "per_device")) == "global"
+        else int(cfg.experiment.batches)
+    )
     lr0 = calculate_initial_lr(
         float(cfg.experiment.lr),
-        int(cfg.experiment.batches),
+        lr_batch,
         bool(cfg.parameter.linear_schedule),
     )
     schedule = warmup_cosine_schedule(lr0, total_steps, warmup_steps)
@@ -127,13 +136,22 @@ def run_supervised(cfg: Config) -> dict:
     best_epoch = 0
     history = []
     t_start = time.time()
+    cur_step = 0  # host-side mirror of state.step: avoids per-step device sync
+    tracer = StepTraceWindow(
+        cfg.select("experiment.profile_dir"),
+        start=2,
+        length=int(cfg.select("experiment.profile_steps", 10) or 10),
+        enabled=is_logging_host(),
+    )
     for epoch in range(1, epochs + 1):
         train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
         for batch in prefetch(train_iter.batches(epoch)):
-            step_rng = jax.random.fold_in(base_key, int(state.step))
+            tracer.tick(cur_step, pending=train_metrics["loss"])
+            step_rng = jax.random.fold_in(base_key, cur_step)
             state, train_metrics = train_step(
                 state, batch["image"], batch["label"], step_rng
             )
+            cur_step += 1
 
         # distributed validation (reference supervised.py:30-58,135-139)
         sum_loss, correct, count = 0.0, 0.0, 0.0
@@ -172,7 +190,7 @@ def run_supervised(cfg: Config) -> dict:
                 "Epoch:%d/%d progress:%.3f train_loss:%.3f val_loss:%.4f "
                 "val_acc:%.4f lr:%.7f",
                 epoch, epochs, epoch / epochs, float(train_metrics["loss"]),
-                val_loss, val_acc, float(schedule(max(int(state.step) - 1, 0))),
+                val_loss, val_acc, float(schedule(max(cur_step - 1, 0))),
             )
 
         # best-only checkpoint policy (reference supervised.py:144-162)
@@ -192,6 +210,7 @@ def run_supervised(cfg: Config) -> dict:
             save_checkpoint(best_path, state)
 
     del t_start
+    tracer.close(pending=train_metrics["loss"])
     return {
         "best_epoch": best_epoch,
         "best_value": best_value,

@@ -33,6 +33,43 @@ def trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
+class StepTraceWindow:
+    """Capture a profiler trace of steps [start, start+length) of a loop.
+
+    Call :meth:`tick` once per step with the host step index (before
+    running the step); call :meth:`close` after the loop — the trace is
+    stopped there too if the window ran past the end of training.
+    """
+
+    def __init__(self, log_dir: str | None, start: int, length: int, enabled: bool = True):
+        self.log_dir = log_dir
+        self.start = start
+        self.stop_at = start + length
+        self.enabled = bool(log_dir) and enabled
+        self._active = False
+
+    def tick(self, step: int, pending=None) -> None:
+        if not self.enabled:
+            return
+        if not self._active and step == self.start:
+            jax.profiler.start_trace(str(self.log_dir))
+            self._active = True
+        elif self._active and step >= self.stop_at:
+            if pending is not None:
+                jax.block_until_ready(pending)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.enabled = False
+
+    def close(self, pending=None) -> None:
+        if self._active:
+            if pending is not None:
+                jax.block_until_ready(pending)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.enabled = False
+
+
 class StepTimer:
     """Steady-state throughput measurement for a compiled step.
 

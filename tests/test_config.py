@@ -120,3 +120,23 @@ def test_config_node_behaves_like_mapping():
     cfg.update_dotted("a.c.d", "x")
     assert cfg.a.c.d == "x"
     assert "a" in cfg and dict(cfg.a.items())["b"] == 1
+
+
+class TestOverrideMarker:
+    def test_override_group_beats_root_defaults(self):
+        from simclr_tpu.config import load_config
+
+        cfg = load_config("config", ["experiment=cifar10-large-batch"])
+        assert cfg.parameter.lr_scale_batch == "global"
+        assert cfg.parameter.linear_schedule is False
+        # non-override groups still lose to root (reference semantics)
+        assert cfg.parameter.seed == 7
+
+    def test_cli_still_beats_override_group(self):
+        from simclr_tpu.config import load_config
+
+        cfg = load_config(
+            "config",
+            ["experiment=cifar10-large-batch", "parameter.linear_schedule=true"],
+        )
+        assert cfg.parameter.linear_schedule is True

@@ -174,3 +174,25 @@ class TestSupervised:
         ckpts = [d for d in os.listdir(save_dir) if d.startswith("epoch=")]
         assert len(ckpts) == 1
         assert summary["metric"] == "loss"
+
+
+class TestProfileTrace:
+    def test_trace_written_and_closed(self, tmp_path):
+        """profile_dir captures a steady-state trace; short runs still close it."""
+        save_dir = str(tmp_path / "prof-run")
+        trace_dir = str(tmp_path / "trace")
+        pretrain_main(
+            SYNTH
+            + [
+                "parameter.epochs=2",
+                "parameter.warmup_epochs=0",
+                "experiment.save_model_epoch=2",
+                f"experiment.profile_dir={trace_dir}",
+                "experiment.profile_steps=100",  # window outlives the run
+                f"experiment.save_dir={save_dir}",
+            ]
+        )
+        import glob
+
+        assert glob.glob(os.path.join(trace_dir, "**", "*.pb"), recursive=True) or \
+            glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)

@@ -91,19 +91,18 @@ def ntxent_loss(
     return _reduce(per_anchor, reduction)
 
 
-def ntxent_loss_sharded_rows(
-    z0: jnp.ndarray,
-    z1: jnp.ndarray,
-    axis_name: str,
-    temperature: float = 0.5,
-) -> jnp.ndarray:
-    """Global-negatives NT-Xent inside ``shard_map``/``pmap``.
+def gather_global_candidates(
+    z0: jnp.ndarray, z1: jnp.ndarray, axis_name: str
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared layout for gathered-negative losses, inside ``shard_map``.
 
-    Gathers embeddings (cheap: activations, not params — SURVEY §5.7) over
-    ``axis_name`` to form the global candidate set, but computes similarity
-    rows only for local anchors. Returns the global mean loss (identical on
-    every replica); gradients flow through the gather (its transpose is a
-    psum-scatter, so each replica ends up with exactly its local grads).
+    Returns ``(z_local, candidates, self_idx, pos_idx)``: normalized local
+    anchors ``[z0_local | z1_local]``, the all-gathered candidate set
+    ``[all z0 | all z1]``, and each local anchor's own / positive global
+    column. Both the XLA (:func:`ntxent_loss_sharded_rows`) and Pallas-fused
+    (``ntxent_pallas.ntxent_loss_fused_sharded``) losses consume exactly this
+    layout — keep it single-sourced so their self-mask columns can never
+    drift apart (their parity is test-asserted).
     """
     n_local = z0.shape[0]
     shard = jax.lax.axis_index(axis_name)
@@ -121,9 +120,28 @@ def ntxent_loss_sharded_rows(
     idx1 = n_global + idx0                       # global cols of local view-1
     self_idx = jnp.concatenate([idx0, idx1])
     pos_idx = jnp.concatenate([idx1, idx0])
+    return z_local, candidates, self_idx, pos_idx
 
+
+def ntxent_loss_sharded_rows(
+    z0: jnp.ndarray,
+    z1: jnp.ndarray,
+    axis_name: str,
+    temperature: float = 0.5,
+) -> jnp.ndarray:
+    """Global-negatives NT-Xent inside ``shard_map``/``pmap``.
+
+    Gathers embeddings (cheap: activations, not params — SURVEY §5.7) over
+    ``axis_name`` to form the global candidate set, but computes similarity
+    rows only for local anchors. Returns the global mean loss (identical on
+    every replica); gradients flow through the gather (its transpose is a
+    psum-scatter, so each replica ends up with exactly its local grads).
+    """
+    z_local, candidates, self_idx, pos_idx = gather_global_candidates(
+        z0, z1, axis_name
+    )
     per_anchor = _anchor_losses(z_local, candidates, self_idx, pos_idx, temperature)
-    # mean over ALL 2*n_global anchors = pmean of local means
+    # mean over ALL global anchors = pmean of local means
     return jax.lax.pmean(per_anchor.mean(), axis_name)
 
 

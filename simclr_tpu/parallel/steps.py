@@ -43,7 +43,10 @@ from simclr_tpu.ops.ntxent import (
     ntxent_loss_local_negatives,
     ntxent_loss_sharded_rows,
 )
-from simclr_tpu.ops.ntxent_pallas import ntxent_loss_fused
+from simclr_tpu.ops.ntxent_pallas import (
+    ntxent_loss_fused,
+    ntxent_loss_fused_sharded,
+)
 from simclr_tpu.ops.ntxent_ring import ntxent_loss_ring
 from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from simclr_tpu.parallel.train_state import TrainState
@@ -114,12 +117,12 @@ def make_pretrain_step(
     ``images`` the raw uint8 global batch sharded over the data axis. The
     model must be constructed with ``bn_cross_replica_axis=DATA_AXIS``.
 
-    ``fused=True`` routes the loss through the Pallas blockwise kernel
-    (``ops/ntxent_pallas.py``), which never materializes the similarity
-    matrix — worthwhile at large per-shard batches. Supported for ``local``
-    negatives on any mesh and for ``global``/``ring`` on a single-data-shard
-    mesh (where the local batch IS the global batch); the multi-shard global
-    candidate set keeps the XLA gather/ring paths.
+    ``fused=True`` routes the loss through the Pallas blockwise kernels
+    (``ops/ntxent_pallas.py``), which never materialize the similarity
+    matrix — worthwhile at large (global) batches. Supported with ``local``
+    negatives (per-shard kernel) and ``global`` negatives (local anchors
+    against the all-gathered candidate set); ``ring`` IS the streaming
+    formulation already and has no fused variant.
     """
     if negatives not in ("global", "local", "ring"):
         raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
@@ -128,11 +131,10 @@ def make_pretrain_step(
             f"forward_mode must be two_pass|concat, got {forward_mode!r}"
         )
     apply_views = _apply_two_pass if forward_mode == "two_pass" else _apply_concat
-    n_data_shards = mesh.shape[DATA_AXIS]
-    if fused and negatives != "local" and n_data_shards > 1:
+    if fused and negatives == "ring":
         raise ValueError(
-            "loss.fused currently supports negatives='local' on multi-shard "
-            "meshes, or any mode on a single-data-shard mesh"
+            "loss.fused does not combine with negatives='ring' (the ring loss "
+            "is already blockwise); use negatives='global' with fused"
         )
 
     def local_step(state: TrainState, images: jnp.ndarray, rng: jax.Array):
@@ -141,9 +143,9 @@ def make_pretrain_step(
 
         def loss_fn(params):
             z0, z1, new_stats = apply_views(model, params, state.batch_stats, v0, v1)
-            if fused:
-                # per-shard fused kernel; pmean = reference DDP averaging
-                # (on a 1-shard mesh this IS the global objective)
+            if fused and negatives == "global":
+                loss = ntxent_loss_fused_sharded(z0, z1, DATA_AXIS, temperature)
+            elif fused:  # local negatives, per-shard fused kernel
                 loss = jax.lax.pmean(
                     ntxent_loss_fused(z0, z1, temperature), DATA_AXIS
                 )

@@ -112,14 +112,46 @@ class TestFusedInTrainStep:
             losses[fused] = float(metrics["loss"])
         np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
 
-    def test_fused_global_multishard_rejected(self):
+    def test_fused_ring_rejected(self):
         from simclr_tpu.ops.lars import lars
         from simclr_tpu.parallel.mesh import create_mesh
         from simclr_tpu.parallel.steps import make_pretrain_step
 
         mesh = create_mesh()
         with pytest.raises(ValueError, match="fused"):
-            make_pretrain_step(None, lars(0.1), mesh, negatives="global", fused=True)
+            make_pretrain_step(None, lars(0.1), mesh, negatives="ring", fused=True)
+
+    def test_fused_global_matches_gathered_in_step(self):
+        """fused+global on the 8-shard mesh == the XLA gathered objective."""
+        import numpy as np
+
+        from simclr_tpu.ops.lars import lars
+        from simclr_tpu.parallel.mesh import batch_sharding, create_mesh
+        from simclr_tpu.parallel.steps import make_pretrain_step
+        from simclr_tpu.parallel.train_state import create_train_state
+        from tests.helpers import TinyContrastive as Tiny
+
+        mesh = create_mesh()
+        model = Tiny()
+        tx = lars(0.1)
+        images = np.random.default_rng(1).integers(
+            0, 256, size=(32, 32, 32, 3), dtype=np.uint8
+        )
+        losses = {}
+        for fused in (False, True):
+            state = create_train_state(
+                model, tx, jax.random.key(0), jnp.zeros((32, 32, 32, 3))
+            )
+            step = make_pretrain_step(
+                model, tx, mesh, negatives="global", fused=fused
+            )
+            _, metrics = step(
+                state,
+                jax.device_put(images, batch_sharding(mesh)),
+                jax.random.key(1),
+            )
+            losses[fused] = float(metrics["loss"])
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
 
 
 class TestMultihostNoop:
@@ -144,3 +176,52 @@ class TestFusedPaddingPath:
         np.testing.assert_allclose(
             np.asarray(g_fused), np.asarray(g_ref), rtol=1e-4, atol=1e-6
         )
+
+
+class TestFusedSharded:
+    def _views(self, n=32, d=16, seed=10):
+        rng = np.random.default_rng(seed)
+        return (
+            jnp.asarray(rng.normal(size=(n, d)).astype(np.float32)),
+            jnp.asarray(rng.normal(size=(n, d)).astype(np.float32)),
+        )
+
+    def _sharded(self, loss_fn):
+        from jax.sharding import PartitionSpec as P
+
+        from simclr_tpu.parallel.mesh import DATA_AXIS, create_mesh
+
+        mesh = create_mesh()
+        f = jax.shard_map(
+            lambda a, b: loss_fn(a, b, DATA_AXIS, 0.5),
+            mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P(),
+            check_vma=False,
+        )
+        return f
+
+    def test_forward_matches_gathered(self):
+        from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
+        from simclr_tpu.ops.ntxent_pallas import ntxent_loss_fused_sharded
+
+        z0, z1 = self._views()
+        fused = float(jax.jit(self._sharded(ntxent_loss_fused_sharded))(z0, z1))
+        ref = float(jax.jit(self._sharded(ntxent_loss_sharded_rows))(z0, z1))
+        np.testing.assert_allclose(fused, ref, rtol=1e-5)
+
+    def test_grads_match_gathered(self):
+        from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
+        from simclr_tpu.ops.ntxent_pallas import ntxent_loss_fused_sharded
+
+        z0, z1 = self._views(seed=11)
+        g_fused = jax.jit(
+            jax.grad(lambda a, b: self._sharded(ntxent_loss_fused_sharded)(a, b),
+                     argnums=(0, 1))
+        )(z0, z1)
+        g_ref = jax.jit(
+            jax.grad(lambda a, b: self._sharded(ntxent_loss_sharded_rows)(a, b),
+                     argnums=(0, 1))
+        )(z0, z1)
+        for a, b in zip(g_fused, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )

@@ -24,7 +24,7 @@ import jax
 import numpy as np
 
 from simclr_tpu.data.cifar import Dataset
-from simclr_tpu.native.lib import gather_rows2
+from simclr_tpu.native.lib import DEFAULT_THREADS, gather_rows2
 
 
 def epoch_permutation(num_samples: int, seed: int, epoch: int) -> np.ndarray:
@@ -52,6 +52,7 @@ class EpochIterator:
         shuffle: bool = True,
         sharding: jax.sharding.NamedSharding | None = None,
         drop_last: bool = True,
+        gather_threads: int | None = None,
     ):
         if global_batch <= 0:
             raise ValueError("global_batch must be positive")
@@ -61,6 +62,12 @@ class EpochIterator:
         self.shuffle = shuffle
         self.sharding = sharding
         self.drop_last = drop_last
+        # native gather thread-pool width; the reference's parameter
+        # 'num_workers' (DataLoader workers) maps here. 0 means
+        # single-threaded (like num_workers=0), not "use the default".
+        self.gather_threads = (
+            gather_threads if gather_threads is not None else DEFAULT_THREADS
+        )
         n = len(dataset)
         self.steps_per_epoch = n // global_batch if drop_last else -(-n // global_batch)
         if self.steps_per_epoch == 0:
@@ -98,7 +105,8 @@ class EpochIterator:
             local_idx = idx[proc * per_host : (proc + 1) * per_host]
             # native multithreaded row gather (numpy-take fallback inside)
             images, labels = gather_rows2(
-                self.dataset.images, self.dataset.labels, local_idx
+                self.dataset.images, self.dataset.labels, local_idx,
+                n_threads=self.gather_threads,
             )
             batch = {"image": images, "label": labels}
             if self.sharding is not None:

@@ -144,6 +144,13 @@ def learnable_probe(
     ``ceil`` step accounting (probe loaders have drop_last=False), scheduler
     stepped per batch (``/root/reference/eval.py:145-159``); per-epoch full
     train/val accuracy+loss sweeps (``eval.py:161-189``).
+
+    TPU-native structure: the ENTIRE probe run — every epoch, every SGD step,
+    every per-epoch metrics sweep — is one ``lax.scan``-of-``lax.scan`` XLA
+    program dispatched once, with the cached feature matrix resident on
+    device and per-epoch shuffles precomputed on host as an index tensor.
+    The reference's eager loop pays a host round-trip per 512-row batch;
+    here the per-epoch log lines are emitted after the compiled run.
     """
     epochs = int(cfg.parameter.epochs)
     batch = int(cfg.experiment.batches)
@@ -177,7 +184,6 @@ def learnable_probe(
     Xv = jnp.asarray(val_X)
     yv = jnp.asarray(val_y)
 
-    @jax.jit
     def train_step(params, opt_state, batch_stats, xb, yb, mask):
         def loss_fn(p):
             if has_bn:
@@ -200,7 +206,6 @@ def learnable_probe(
         params = optax.apply_updates(params, updates)
         return params, opt_state, new_stats, loss
 
-    @jax.jit
     def dataset_metrics(params, batch_stats, Xs, ys):
         if has_bn:
             logits = clf.apply(
@@ -211,37 +216,61 @@ def learnable_probe(
         logits = logits.astype(jnp.float32)
         loss_sum = optax.softmax_cross_entropy_with_integer_labels(logits, ys).sum()
         top1, topk = _topk_correct(logits, ys, top_k)
-        return top1, topk, loss_sum
+        return top1.astype(jnp.float32), topk.astype(jnp.float32), loss_sum
 
+    # per-epoch shuffles precomputed as one (epochs, steps, batch) tensor;
+    # same RNG draw order as an eager per-epoch loop
     rng = np.random.default_rng(seed)
-    train_accs, train_topk_accs, train_losses = [], [], []
-    val_accs, val_topk_accs, val_losses = [], [], []
-    for epoch in range(1, epochs + 1):
-        order = rng.permutation(n)
-        pad = steps_per_epoch * batch - n
-        padded = np.concatenate([order, np.zeros(pad, np.int64)]) if pad else order
-        mask_full = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
-        sum_loss = 0.0
-        for s in range(steps_per_epoch):
-            idx = padded[s * batch : (s + 1) * batch]
-            mask = jnp.asarray(mask_full[s * batch : (s + 1) * batch])
-            params, opt_state, batch_stats, loss = train_step(
-                params, opt_state, batch_stats, X[idx], y[idx], mask
-            )
-            sum_loss += float(loss) * float(mask.sum())
+    pad = steps_per_epoch * batch - n
+    idx_np = np.zeros((epochs, steps_per_epoch * batch), np.int32)
+    for e in range(epochs):
+        order = rng.permutation(n).astype(np.int32)
+        idx_np[e, :n] = order
+    idx_all = jnp.asarray(idx_np.reshape(epochs, steps_per_epoch, batch))
+    mask_np = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    mask_epoch = jnp.asarray(mask_np.reshape(steps_per_epoch, batch))
 
-        tr1, trk, trl = dataset_metrics(params, batch_stats, X, y)
-        va1, vak, val_ = dataset_metrics(params, batch_stats, Xv, yv)
-        train_accs.append(float(tr1) / n)
-        train_topk_accs.append(float(trk) / n)
-        train_losses.append(float(trl) / n)
-        val_accs.append(float(va1) / len(val_y))
-        val_topk_accs.append(float(vak) / len(val_y))
-        val_losses.append(float(val_) / len(val_y))
-        if is_logging_host():
+    @jax.jit
+    def run_probe(params, opt_state, batch_stats, idx_all, X, y, Xv, yv):
+        # features enter as jit ARGUMENTS, not closure constants: run_eval
+        # calls this once per checkpoint, and baked-in 50000 x d constants
+        # would otherwise be duplicated into every compiled program
+        def step_body(carry, st):
+            p, o, s = carry
+            i, mk = st
+            p, o, s, loss = train_step(p, o, s, X[i], y[i], mk)
+            return (p, o, s), loss * mk.sum()
+
+        def epoch_body(carry, idx_e):
+            carry, losses = jax.lax.scan(
+                step_body, carry, (idx_e, mask_epoch)
+            )
+            p, o, s = carry
+            tr = dataset_metrics(p, s, X, y)
+            va = dataset_metrics(p, s, Xv, yv)
+            return carry, (losses.sum(), tr, va)
+
+        return jax.lax.scan(epoch_body, (params, opt_state, batch_stats), idx_all)
+
+    (params, opt_state, batch_stats), (epoch_losses, tr_hist, va_hist) = run_probe(
+        params, opt_state, batch_stats, idx_all, X, y, Xv, yv
+    )
+    epoch_losses = np.asarray(epoch_losses)
+    tr1, trk, trl = (np.asarray(a) for a in tr_hist)
+    va1, vak, val_ = (np.asarray(a) for a in va_hist)
+    # .tolist() -> Python floats (JSON-serializable, like the eager loop's)
+    train_accs = (tr1 / n).tolist()
+    train_topk_accs = (trk / n).tolist()
+    train_losses = (trl / n).tolist()
+    val_accs = (va1 / len(val_y)).tolist()
+    val_topk_accs = (vak / len(val_y)).tolist()
+    val_losses = (val_ / len(val_y)).tolist()
+    if is_logging_host():
+        for epoch in range(1, epochs + 1):
             logger.info(
                 "probe %s epoch:%d/%d loss:%.4f val_acc:%.4f",
-                kind, epoch, epochs, sum_loss / n, val_accs[-1],
+                kind, epoch, epochs, epoch_losses[epoch - 1] / n,
+                val_accs[epoch - 1],
             )
 
     return {

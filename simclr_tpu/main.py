@@ -145,7 +145,8 @@ def run_pretrain(cfg: Config) -> dict:
     )
     data_shard = batch_sharding(mesh)
     iterator = EpochIterator(
-        dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard
+        dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
+        gather_threads=int(cfg.parameter.num_workers),
     )
 
     if is_logging_host():

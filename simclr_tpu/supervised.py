@@ -113,7 +113,8 @@ def run_supervised(cfg: Config) -> dict:
     eval_step = make_supervised_eval_step(model, mesh)
     data_shard = batch_sharding(mesh)
     train_iter = EpochIterator(
-        train_ds, global_batch, seed=seed, shuffle=True, sharding=data_shard
+        train_ds, global_batch, seed=seed, shuffle=True, sharding=data_shard,
+        gather_threads=int(cfg.parameter.num_workers),
     )
     # validation: no shuffle, keep every sample (reference drop_last=False,
     # supervised.py:219-223). Tail remainder is evaluated in a host-side pass.

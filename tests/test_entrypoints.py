@@ -53,7 +53,12 @@ class TestPretrain:
 
     def test_resume_continues_from_checkpoint(self, pretrain_run, tmp_path):
         """Re-running with resume=true and more epochs continues, not restarts."""
-        save_dir = pretrain_run["save_dir"]
+        # copy the run dir: the module fixture must stay immutable for the
+        # eval tests that enumerate its checkpoints
+        import shutil
+
+        save_dir = str(tmp_path / "resume-copy")
+        shutil.copytree(pretrain_run["save_dir"], save_dir)
         summary = pretrain_main(
             SYNTH
             + [
@@ -79,7 +84,7 @@ class TestEval:
                 f"experiment.save_dir={out}",
             ]
         )
-        assert len(results) == 3  # epochs 1, 2, and the resume run's epoch 3
+        assert set(results.keys()) == {"epoch=1-cifar10", "epoch=2-cifar10"}
         for metrics in results.values():
             assert 0.0 <= metrics["val_acc"] <= 1.0
             assert metrics["val_acc"] <= metrics["val_top_5_acc"] <= 1.0

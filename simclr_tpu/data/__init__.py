@@ -10,6 +10,7 @@ from simclr_tpu.data.cifar import (
     synthetic_dataset,
 )
 from simclr_tpu.data.pipeline import EpochIterator, epoch_permutation
+from simclr_tpu.data.prefetch import Prefetcher, prefetch
 
 __all__ = [
     "simclr_augment_single",
@@ -21,4 +22,6 @@ __all__ = [
     "synthetic_dataset",
     "EpochIterator",
     "epoch_permutation",
+    "Prefetcher",
+    "prefetch",
 ]

@@ -142,6 +142,7 @@ def run_pretrain(cfg: Config) -> dict:
         negatives=str(cfg.select("loss.negatives", "global")),
         fused=bool(cfg.select("loss.fused", False)),
         forward_mode=str(cfg.select("model.forward_mode", "two_pass")),
+        remat=bool(cfg.select("model.remat", False)),
     )
     data_shard = batch_sharding(mesh)
     iterator = EpochIterator(

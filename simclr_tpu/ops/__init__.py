@@ -1,15 +1,27 @@
 from simclr_tpu.ops.lars import lars, scale_by_larc, simclr_weight_decay_mask
 from simclr_tpu.ops.ntxent import (
+    gather_global_candidates,
     ntxent_loss,
     ntxent_loss_local_negatives,
     ntxent_loss_sharded_rows,
 )
+from simclr_tpu.ops.ntxent_pallas import (
+    masked_lse_pair,
+    ntxent_loss_fused,
+    ntxent_loss_fused_sharded,
+)
+from simclr_tpu.ops.ntxent_ring import ntxent_loss_ring
 
 __all__ = [
     "lars",
     "scale_by_larc",
     "simclr_weight_decay_mask",
+    "gather_global_candidates",
     "ntxent_loss",
     "ntxent_loss_local_negatives",
     "ntxent_loss_sharded_rows",
+    "masked_lse_pair",
+    "ntxent_loss_fused",
+    "ntxent_loss_fused_sharded",
+    "ntxent_loss_ring",
 ]

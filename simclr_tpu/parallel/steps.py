@@ -65,25 +65,37 @@ def _augment_two_views(rng, images, strength, out_size):
     return aug(keys[:n], images, strength, out_size), aug(keys[n:], images, strength, out_size)
 
 
-def _apply_two_pass(model, params, batch_stats, v0, v1):
+def _forward_fn(model, remat: bool):
+    """Mutable-BN training forward, optionally rematerialized.
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint``: activations are
+    recomputed during the backward pass instead of stored, trading ~1/3 more
+    FLOPs for O(depth) less HBM — the enabler for very large per-chip
+    batches (``model.remat`` config).
+    """
+
+    def fwd(params, batch_stats, v):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats}, v, train=True,
+            mutable=["batch_stats"],
+        )
+
+    return jax.checkpoint(fwd) if remat else fwd
+
+
+def _apply_two_pass(fwd, params, batch_stats, v0, v1):
     """Two sequential forwards threading BN running stats.
 
     Matches the reference's per-view forwards (``main.py:112-113``): each
     view's batch forms its own BN batch statistics and the running stats get
     two momentum updates per step — NOT one concatenated 2B forward.
     """
-    z0, mut = model.apply(
-        {"params": params, "batch_stats": batch_stats}, v0, train=True,
-        mutable=["batch_stats"],
-    )
-    z1, mut = model.apply(
-        {"params": params, "batch_stats": mut["batch_stats"]}, v1, train=True,
-        mutable=["batch_stats"],
-    )
+    z0, mut = fwd(params, batch_stats, v0)
+    z1, mut = fwd(params, mut["batch_stats"], v1)
     return z0, z1, mut["batch_stats"]
 
 
-def _apply_concat(model, params, batch_stats, v0, v1):
+def _apply_concat(fwd, params, batch_stats, v0, v1):
     """One forward over the concatenated 2B batch (performance option).
 
     Halves kernel-launch/weight-streaming overhead by doubling every matmul's
@@ -92,10 +104,7 @@ def _apply_concat(model, params, batch_stats, v0, v1):
     semantic deviation behind ``model.forward_mode=concat``.
     """
     n = v0.shape[0]
-    z, mut = model.apply(
-        {"params": params, "batch_stats": batch_stats},
-        jnp.concatenate([v0, v1], axis=0), train=True, mutable=["batch_stats"],
-    )
+    z, mut = fwd(params, batch_stats, jnp.concatenate([v0, v1], axis=0))
     return z[:n], z[n:], mut["batch_stats"]
 
 
@@ -109,6 +118,7 @@ def make_pretrain_step(
     negatives: str = "global",
     fused: bool = False,
     forward_mode: str = "two_pass",
+    remat: bool = False,
     out_size: int = 32,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
     """Build the jitted contrastive train step.
@@ -131,6 +141,7 @@ def make_pretrain_step(
             f"forward_mode must be two_pass|concat, got {forward_mode!r}"
         )
     apply_views = _apply_two_pass if forward_mode == "two_pass" else _apply_concat
+    forward = _forward_fn(model, remat)
     if fused and negatives == "ring":
         raise ValueError(
             "loss.fused does not combine with negatives='ring' (the ring loss "
@@ -142,7 +153,7 @@ def make_pretrain_step(
         v0, v1 = _augment_two_views(rng, images, strength, out_size)
 
         def loss_fn(params):
-            z0, z1, new_stats = apply_views(model, params, state.batch_stats, v0, v1)
+            z0, z1, new_stats = apply_views(forward, params, state.batch_stats, v0, v1)
             if fused and negatives == "global":
                 loss = ntxent_loss_fused_sharded(z0, z1, DATA_AXIS, temperature)
             elif fused:  # local negatives, per-shard fused kernel

@@ -239,3 +239,25 @@ class TestForwardMode:
         mesh = create_mesh()
         with pytest.raises(ValueError, match="forward_mode"):
             make_pretrain_step(None, lars(0.1), mesh, forward_mode="bogus")
+
+
+class TestRemat:
+    def test_remat_matches_plain(self):
+        """jax.checkpoint must not change values, only the backward schedule."""
+        mesh = create_mesh()
+        model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
+        tx = lars(0.1)
+        images = _images(16, seed=11)
+        results = {}
+        for remat in (False, True):
+            state = _make_state(model, tx)
+            step = make_pretrain_step(model, tx, mesh, remat=remat)
+            state, metrics = step(
+                state, jax.device_put(images, batch_sharding(mesh)), jax.random.key(5)
+            )
+            results[remat] = (
+                float(metrics["loss"]),
+                np.asarray(jax.tree.leaves(state.params)[0]),
+            )
+        np.testing.assert_allclose(results[True][0], results[False][0], rtol=1e-6)
+        np.testing.assert_allclose(results[True][1], results[False][1], rtol=1e-5)

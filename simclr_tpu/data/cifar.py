@@ -106,13 +106,18 @@ def synthetic_dataset(
     num_classes = NUM_CLASSES[name]
     if size is None:
         size = _TRAIN_SIZES[name] if split == "train" else _TEST_SIZES[name]
-    rng = np.random.default_rng(seed + (0 if split == "train" else 1))
+    # class prototypes are SHARED across splits (train and test must mean
+    # the same thing by "class k"); only the per-sample noise differs
+    proto_rng = np.random.default_rng(seed)
+    noise_rng = np.random.default_rng(seed + (1000 if split == "train" else 2000))
     # float32/uint8 throughout: the default 50k split would otherwise build
     # multi-GB int64/float64 temporaries on the small smoke-test hosts this
     # fallback exists for
-    prototypes = rng.integers(0, 256, size=(num_classes, 32, 32, 3)).astype(np.float32)
+    prototypes = proto_rng.integers(0, 256, size=(num_classes, 32, 32, 3)).astype(
+        np.float32
+    )
     labels = np.arange(size, dtype=np.int32) % num_classes
-    noise = rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
+    noise = noise_rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
     noise *= 24.0
     noise += prototypes[labels]
     images = np.clip(noise, 0, 255, out=noise).astype(np.uint8)

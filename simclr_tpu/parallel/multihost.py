@@ -49,9 +49,16 @@ def maybe_initialize_multihost() -> bool:
             "JAX_NUM_PROCESSES",
         )
     )
-    on_tpu_slice = os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
-        "MEGASCALE_COORDINATOR_ADDRESS"
-    )
+    # TPU-slice metadata only counts when we are actually running on TPU —
+    # a CPU-forced dev run on a TPU host must not try to rendezvous.
+    # JAX_PLATFORMS is a priority list; its FIRST entry is the default
+    # backend, so 'cpu' or 'cpu,tpu' both mean a CPU run.
+    platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    cpu_forced = platforms.split(",")[0].strip() == "cpu"
+    on_tpu_slice = (
+        os.environ.get("TPU_WORKER_HOSTNAMES")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    ) and not cpu_forced
     if not env_configured and not on_tpu_slice:
         return False
 

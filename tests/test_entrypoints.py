@@ -201,3 +201,36 @@ class TestProfileTrace:
 
         assert glob.glob(os.path.join(trace_dir, "**", "*.pb"), recursive=True) or \
             glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
+
+
+class TestCifar100:
+    def test_pretrain_and_centroid_eval(self, tmp_path):
+        """The cifar100 branch: 100-class synthetic data through pretrain ->
+        centroid probe (NUM_CLASSES plumbing in both entry points)."""
+        save_dir = str(tmp_path / "c100")
+        pretrain_main(
+            [
+                "experiment=cifar100",
+                "experiment.synthetic_data=true",
+                "experiment.synthetic_size=200",
+                "experiment.batches=4",
+                "parameter.epochs=1",
+                "parameter.warmup_epochs=0",
+                "experiment.save_model_epoch=1",
+                f"experiment.save_dir={save_dir}",
+            ]
+        )
+        results = eval_main(
+            [
+                "experiment.name=cifar100",
+                "experiment.synthetic_data=true",
+                "experiment.synthetic_size=200",
+                "experiment.batches=4",
+                "parameter.classifier=centroid",
+                f"experiment.target_dir={save_dir}",
+                f"experiment.save_dir={tmp_path / 'c100-eval'}",
+            ]
+        )
+        (metrics,) = results.values()
+        # 100-class synthetic: top-5 >= top-1, both valid probabilities
+        assert 0.0 <= metrics["val_acc"] <= metrics["val_top_5_acc"] <= 1.0

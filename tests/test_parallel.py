@@ -261,3 +261,22 @@ class TestRemat:
             )
         np.testing.assert_allclose(results[True][0], results[False][0], rtol=1e-6)
         np.testing.assert_allclose(results[True][1], results[False][1], rtol=1e-5)
+
+
+class TestStepTimer:
+    def test_throughput_summary(self):
+        from simclr_tpu.utils.profiling import StepTimer
+
+        timer = StepTimer(global_batch=32, warmup=2)
+        x = jnp.ones((4,))
+        for _ in range(6):
+            timer.tick(x)
+        summary = timer.summary()
+        assert summary["steps"] == 4
+        assert summary["imgs_per_sec"] > 0
+        assert summary["imgs_per_sec_per_chip"] == summary["imgs_per_sec"] / 8
+
+    def test_no_ticks_safe(self):
+        from simclr_tpu.utils.profiling import StepTimer
+
+        assert StepTimer(32).summary()["steps"] == 0

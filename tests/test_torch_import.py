@@ -164,3 +164,59 @@ class TestImportParity:
         )
         for p, leaf in flat_a:
             assert flat_b[jax.tree_util.keystr(p)].shape == leaf.shape, p
+
+
+class TestResnet50Mapping:
+    def test_bottleneck_tree_structure(self):
+        """Synthetic resnet50-shaped state dict maps onto the flax init tree
+        (catches conv/bn ordering and downsample placement for bottlenecks,
+        including stage 1's stride-1 projection shortcut)."""
+        import numpy as np
+
+        sd = {}
+
+        def bn(prefix, c):
+            sd[f"{prefix}.weight"] = np.ones(c, np.float32)
+            sd[f"{prefix}.bias"] = np.zeros(c, np.float32)
+            sd[f"{prefix}.running_mean"] = np.zeros(c, np.float32)
+            sd[f"{prefix}.running_var"] = np.ones(c, np.float32)
+
+        sd["f.conv1.weight"] = np.zeros((64, 3, 3, 3), np.float32)
+        bn("f.bn1", 64)
+        stage_sizes = (3, 4, 6, 3)
+        widths = (64, 128, 256, 512)
+        cin = 64
+        for stage, (blocks, w) in enumerate(zip(stage_sizes, widths), start=1):
+            for b in range(blocks):
+                p = f"f.layer{stage}.{b}"
+                c_in = cin if b == 0 else w * 4
+                sd[f"{p}.conv1.weight"] = np.zeros((w, c_in, 1, 1), np.float32)
+                bn(f"{p}.bn1", w)
+                sd[f"{p}.conv2.weight"] = np.zeros((w, w, 3, 3), np.float32)
+                bn(f"{p}.bn2", w)
+                sd[f"{p}.conv3.weight"] = np.zeros((w * 4, w, 1, 1), np.float32)
+                bn(f"{p}.bn3", w * 4)
+                if b == 0:  # projection shortcut on every stage's first block
+                    sd[f"{p}.downsample.0.weight"] = np.zeros(
+                        (w * 4, c_in, 1, 1), np.float32
+                    )
+                    bn(f"{p}.downsample.1", w * 4)
+            cin = w * 4
+        sd["g.projection_head.0.weight"] = np.zeros((2048, 2048), np.float32)
+        sd["g.projection_head.0.bias"] = np.zeros(2048, np.float32)
+        bn("g.projection_head.1", 2048)
+        sd["g.projection_head.3.weight"] = np.zeros((128, 2048), np.float32)
+
+        variables = import_contrastive_state_dict(sd, base_cnn="resnet50")
+        flax_model = ContrastiveModel(base_cnn="resnet50", d=128, dtype=jnp.float32)
+        init = flax_model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+
+        def paths(tree):
+            return {
+                jax.tree_util.keystr(p): v.shape
+                for p, v in jax.tree_util.tree_flatten_with_path(tree)[0]
+            }
+
+        got_p, want_p = paths(variables["params"]), paths(init["params"])
+        assert got_p == want_p
+        assert paths(variables["batch_stats"]) == paths(init["batch_stats"])

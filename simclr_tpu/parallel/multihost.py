@@ -62,13 +62,44 @@ def maybe_initialize_multihost() -> bool:
     if not env_configured and not on_tpu_slice:
         return False
 
+    # jax's no-arg initialize() only discovers process count/id on managed
+    # clusters (Cloud TPU metadata, SLURM, OpenMPI, k8s — jax/_src/clusters).
+    # The generic JAX_NUM_PROCESSES / JAX_PROCESS_ID variables this module
+    # documents (and simclr_tpu.launch exports) are our own convention, so
+    # pass them explicitly when present.
+    kwargs: dict = {}
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if coordinator and os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs = {
+            "coordinator_address": coordinator,
+            "num_processes": int(os.environ["JAX_NUM_PROCESSES"]),
+            "process_id": int(os.environ.get("JAX_PROCESS_ID", "0")),
+        }
     try:
-        jax.distributed.initialize()
+        jax.distributed.initialize(**kwargs)
         _initialized = True
         logger.info(
             "multihost: process %d/%d, %d global devices",
             jax.process_index(), jax.process_count(), jax.device_count(),
         )
-    except (RuntimeError, ValueError) as e:  # already initialized / refused
+    except (RuntimeError, ValueError) as e:
+        benign_double_init = (
+            "only be called once" in str(e) or "already initialized" in str(e).lower()
+        )
+        if env_configured and not benign_double_init:
+            # the user explicitly asked for multihost (cluster env vars set);
+            # silently degrading to N independent single-process jobs would
+            # have every host believe it is process 0 — all logging, all
+            # writing checkpoints to the same save_dir. Fail loudly instead
+            # (e.g. JAX_NUM_PROCESSES without JAX_COORDINATOR_ADDRESS).
+            raise RuntimeError(
+                "multihost rendezvous was requested via environment variables "
+                "but jax.distributed.initialize failed; set BOTH "
+                "JAX_COORDINATOR_ADDRESS and JAX_NUM_PROCESSES (and "
+                "JAX_PROCESS_ID on every host), or unset them for a "
+                "single-process run"
+            ) from e
         logger.warning("jax.distributed.initialize skipped: %s", e)
     return jax.process_count() > 1

@@ -6,8 +6,8 @@ builds live or die by the profile, so this module provides:
   * :func:`start_server` — ``jax.profiler`` trace server for live capture
     (connect with TensorBoard / xprof);
   * :func:`trace` — context manager writing a trace for a code region;
-  * :class:`StepTimer` — ``block_until_ready``-bracketed step timing with
-    imgs/sec and imgs/sec/chip (the BASELINE.json north-star metric).
+  * :class:`StepTimer` — value-fetch-bracketed step timing with imgs/sec and
+    imgs/sec/chip (the BASELINE.json north-star metric).
 """
 
 from __future__ import annotations
@@ -16,6 +16,31 @@ import contextlib
 import time
 
 import jax
+import numpy as np
+
+
+def synchronize(tree) -> None:
+    """Wait until ``tree``'s computation has actually finished on device.
+
+    ``jax.block_until_ready`` is NOT a reliable fence on remote-tunneled
+    runtimes: it can return while steps are still queued, which inflates
+    short-window throughput measurements by >10x (observed on the axon TPU
+    tunnel). Fetching a VALUE cannot lie — the scalar only exists once the
+    producing computation (and, through data dependence, everything it
+    consumed) has run. Fetches one element of EVERY array leaf — leaves may
+    come from independent dispatches, so fencing only the first would leave
+    the others queued.
+    """
+    if tree is None:
+        return
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.Array):
+            # multi-process sharded arrays are not fully addressable and
+            # cannot be device_get as a whole; fetching from this process's
+            # first shard still proves the local computation completed
+            if not leaf.is_fully_addressable:
+                leaf = leaf.addressable_shards[0].data
+            np.asarray(jax.device_get(leaf.ravel()[:1]))
 
 
 def start_server(port: int = 9999):
@@ -55,16 +80,14 @@ class StepTraceWindow:
             jax.profiler.start_trace(str(self.log_dir))
             self._active = True
         elif self._active and step >= self.stop_at:
-            if pending is not None:
-                jax.block_until_ready(pending)
+            synchronize(pending)
             jax.profiler.stop_trace()
             self._active = False
             self.enabled = False
 
     def close(self, pending=None) -> None:
         if self._active:
-            if pending is not None:
-                jax.block_until_ready(pending)
+            synchronize(pending)
             jax.profiler.stop_trace()
             self._active = False
             self.enabled = False
@@ -94,8 +117,7 @@ class StepTimer:
         self._count += 1
         self._last = device_output
         if self._count == self.warmup:
-            if device_output is not None:
-                jax.block_until_ready(device_output)
+            synchronize(device_output)
             self._t0 = time.perf_counter()
         elif self._count > self.warmup:
             self._timed_steps += 1
@@ -103,8 +125,7 @@ class StepTimer:
     def summary(self) -> dict:
         if self._t0 is None or self._timed_steps == 0:
             return {"imgs_per_sec": 0.0, "imgs_per_sec_per_chip": 0.0, "steps": 0}
-        if self._last is not None:
-            jax.block_until_ready(self._last)
+        synchronize(self._last)
         dt = time.perf_counter() - self._t0
         imgs_per_sec = self._timed_steps * self.global_batch / dt
         return {

@@ -98,6 +98,8 @@ def main(argv: list[str] | None = None) -> None:
     args = _parse_args(argv)
     if args.nprocs < 1:
         raise SystemExit("--nprocs must be >= 1")
+    if args.devices_per_proc is not None and args.devices_per_proc < 1:
+        raise SystemExit("--devices-per-proc must be >= 1")
 
     if args.proc_id is not None:
         # multi-host mode: become the training module on this host
@@ -106,12 +108,14 @@ def main(argv: list[str] | None = None) -> None:
         runpy.run_module(args.module, run_name="__main__", alter_sys=True)
         return
 
-    # local mode: spawn every process here
+    # local mode: spawn every process here. Spawning INSIDE the try keeps a
+    # mid-spawn interrupt or Popen failure from orphaning children already
+    # started (they would block in rendezvous forever waiting for peers).
     cmd = [sys.executable, "-m", args.module] + list(args.overrides)
-    children = [
-        subprocess.Popen(cmd, env=_child_env(args, i)) for i in range(args.nprocs)
-    ]
+    children: list[subprocess.Popen] = []
     try:
+        for i in range(args.nprocs):
+            children.append(subprocess.Popen(cmd, env=_child_env(args, i)))
         # poll ALL children: an ordered wait() would miss a crash of child k
         # while child 0 blocks in a collective waiting for it, hanging the
         # job instead of failing fast
@@ -133,7 +137,9 @@ def main(argv: list[str] | None = None) -> None:
             for child in children:
                 child.wait()
             raise subprocess.CalledProcessError(failed_rc, cmd)
-    except KeyboardInterrupt:
+    except subprocess.CalledProcessError:
+        raise  # children already reaped above
+    except BaseException:  # interrupt or spawn failure: no orphans
         for child in children:
             if child.poll() is None:
                 child.send_signal(signal.SIGTERM)

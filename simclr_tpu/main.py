@@ -52,7 +52,7 @@ from simclr_tpu.utils.checkpoint import (
     save_checkpoint,
 )
 from simclr_tpu.utils.logging import get_logger, is_logging_host
-from simclr_tpu.utils.profiling import StepTraceWindow
+from simclr_tpu.utils.profiling import StepTimer, StepTraceWindow
 from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
 
 logger = get_logger()
@@ -174,11 +174,15 @@ def run_pretrain(cfg: Config) -> dict:
         enabled=is_logging_host(),
     )
     t_start = time.time()
+    # steady-state throughput, excluding the first (compiling) steps; the
+    # per-epoch log line reports the cumulative rate instead
+    timer = StepTimer(global_batch, warmup=3)
     for epoch in range(start_epoch, epochs + 1):
         for batch in prefetch(iterator.batches(epoch)):
             tracer.tick(cur_step, pending=metrics["loss"])
             step_rng = jax.random.fold_in(base_key, cur_step)
             state, metrics = step_fn(state, batch["image"], step_rng)
+            timer.tick(metrics["loss"])
             cur_step += 1
         if is_logging_host():
             # one line per epoch, the reference's rank-0 log (main.py:124-127)
@@ -196,9 +200,18 @@ def run_pretrain(cfg: Config) -> dict:
             path = os.path.join(
                 save_dir, checkpoint_name(epoch, str(cfg.experiment.output_model_name))
             )
+            timer.pause(metrics["loss"])  # keep save I/O out of the imgs/sec window
             save_checkpoint(path, state)
+            timer.resume()
 
     tracer.close(pending=metrics["loss"])
+    throughput = timer.summary()
+    if is_logging_host() and throughput["steps"] > 0:
+        logger.info(
+            "steady-state: %.0f imgs/sec (%.0f per chip) over %d steps",
+            throughput["imgs_per_sec"], throughput["imgs_per_sec_per_chip"],
+            throughput["steps"],
+        )
     return {
         "final_loss": float(metrics["loss"]),
         "steps": int(state.step),
@@ -206,6 +219,7 @@ def run_pretrain(cfg: Config) -> dict:
         "save_dir": save_dir,
         "global_batch": global_batch,
         "n_data_shards": n_data,
+        "imgs_per_sec_steady": throughput["imgs_per_sec"],
     }
 
 

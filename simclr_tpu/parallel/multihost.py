@@ -72,9 +72,18 @@ def maybe_initialize_multihost() -> bool:
         "COORDINATOR_ADDRESS"
     )
     if coordinator and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if num_processes > 1 and "JAX_PROCESS_ID" not in os.environ:
+            # defaulting every host to process 0 would hang the coordinator
+            # (waiting for N distinct ids that never arrive) instead of
+            # failing fast on all hosts
+            raise RuntimeError(
+                "JAX_NUM_PROCESSES > 1 requires JAX_PROCESS_ID to be set on "
+                "every host (0..N-1)"
+            )
         kwargs = {
             "coordinator_address": coordinator,
-            "num_processes": int(os.environ["JAX_NUM_PROCESSES"]),
+            "num_processes": num_processes,
             "process_id": int(os.environ.get("JAX_PROCESS_ID", "0")),
         }
     try:
@@ -88,18 +97,21 @@ def maybe_initialize_multihost() -> bool:
         benign_double_init = (
             "only be called once" in str(e) or "already initialized" in str(e).lower()
         )
-        if env_configured and not benign_double_init:
-            # the user explicitly asked for multihost (cluster env vars set);
-            # silently degrading to N independent single-process jobs would
-            # have every host believe it is process 0 — all logging, all
-            # writing checkpoints to the same save_dir. Fail loudly instead
-            # (e.g. JAX_NUM_PROCESSES without JAX_COORDINATOR_ADDRESS).
+        if benign_double_init:
+            # the runtime IS initialized (someone else did it) — record that
+            # so later entry-point calls don't re-attempt and re-warn
+            _initialized = True
+            logger.warning("jax.distributed already initialized elsewhere: %s", e)
+        else:
+            # Multihost was explicitly requested (cluster env vars) or this
+            # is a real TPU slice: silently degrading to N independent
+            # single-process jobs would have every host believe it is
+            # process 0 — all logging, all writing checkpoints to the same
+            # save_dir. Fail loudly instead.
             raise RuntimeError(
-                "multihost rendezvous was requested via environment variables "
-                "but jax.distributed.initialize failed; set BOTH "
+                "multihost rendezvous failed; set BOTH "
                 "JAX_COORDINATOR_ADDRESS and JAX_NUM_PROCESSES (and "
                 "JAX_PROCESS_ID on every host), or unset them for a "
                 "single-process run"
             ) from e
-        logger.warning("jax.distributed.initialize skipped: %s", e)
     return jax.process_count() > 1

@@ -35,12 +35,14 @@ def synchronize(tree) -> None:
         return
     for leaf in jax.tree.leaves(tree):
         if isinstance(leaf, jax.Array):
-            # multi-process sharded arrays are not fully addressable and
-            # cannot be device_get as a whole; fetching from this process's
-            # first shard still proves the local computation completed
-            if not leaf.is_fully_addressable:
-                leaf = leaf.addressable_shards[0].data
-            np.asarray(jax.device_get(leaf.ravel()[:1]))
+            # fetch from this process's first shard: works for sharded
+            # arrays that are not fully addressable (multi-process), and a
+            # one-element slice avoids dispatching a full-array reshape/copy
+            # just to prove completion
+            shard = leaf.addressable_shards[0].data
+            if shard.size:
+                shard = shard[(0,) * shard.ndim]
+            np.asarray(jax.device_get(shard))
 
 
 def start_server(port: int = 9999):
@@ -106,12 +108,18 @@ class StepTimer:
     """
 
     def __init__(self, global_batch: int, warmup: int = 3):
+        if warmup < 1:
+            # timing starts at the warmup-th tick; with warmup=0 no tick
+            # would ever set t0 and summary() would silently report zeros
+            raise ValueError("warmup must be >= 1 (the first step compiles)")
         self.global_batch = global_batch
         self.warmup = warmup
         self._count = 0
         self._t0: float | None = None
         self._timed_steps = 0
         self._last = None
+        self._paused_at: float | None = None
+        self._excluded = 0.0
 
     def tick(self, device_output=None) -> None:
         self._count += 1
@@ -122,11 +130,25 @@ class StepTimer:
         elif self._count > self.warmup:
             self._timed_steps += 1
 
+    def pause(self, device_output=None) -> None:
+        """Exclude a non-step interval (checkpoint save, eval sweep) from the
+        timed window. Fences outstanding step work first, so the excluded
+        span contains only the paused activity."""
+        if self._t0 is not None and self._paused_at is None:
+            synchronize(device_output if device_output is not None else self._last)
+            self._paused_at = time.perf_counter()
+
+    def resume(self) -> None:
+        if self._paused_at is not None:
+            self._excluded += time.perf_counter() - self._paused_at
+            self._paused_at = None
+
     def summary(self) -> dict:
         if self._t0 is None or self._timed_steps == 0:
             return {"imgs_per_sec": 0.0, "imgs_per_sec_per_chip": 0.0, "steps": 0}
+        self.resume()
         synchronize(self._last)
-        dt = time.perf_counter() - self._t0
+        dt = time.perf_counter() - self._t0 - self._excluded
         imgs_per_sec = self._timed_steps * self.global_batch / dt
         return {
             "imgs_per_sec": imgs_per_sec,

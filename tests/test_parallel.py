@@ -280,3 +280,27 @@ class TestStepTimer:
         from simclr_tpu.utils.profiling import StepTimer
 
         assert StepTimer(32).summary()["steps"] == 0
+
+    def test_warmup_zero_rejected(self):
+        from simclr_tpu.utils.profiling import StepTimer
+
+        with pytest.raises(ValueError, match="warmup"):
+            StepTimer(32, warmup=0)
+
+    def test_pause_excludes_interval(self):
+        import time
+
+        from simclr_tpu.utils.profiling import StepTimer
+
+        timer = StepTimer(global_batch=32, warmup=1)
+        x = jnp.ones((4,))
+        for _ in range(3):
+            timer.tick(x)
+        timer.pause(x)
+        time.sleep(0.5)  # simulated checkpoint save
+        timer.resume()
+        timer.tick(x)
+        summary = timer.summary()
+        assert summary["steps"] == 3
+        # the paused 0.5s must not count: 3 trivial steps take far less
+        assert summary["seconds"] < 0.4, summary

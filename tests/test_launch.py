@@ -15,14 +15,18 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_launcher(args, timeout=420):
-    env = {
+def _launcher_env():
+    # children must pick their own platform/device env, not inherit the
+    # conftest's in-process pins
+    return {
         k: v
         for k, v in os.environ.items()
-        # children must pick their own platform/device env, not inherit the
-        # conftest's in-process pins
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
     }
+
+
+def _run_launcher(args, timeout=420):
+    env = _launcher_env()
     return subprocess.run(
         [sys.executable, "-m", "simclr_tpu.launch", *args],
         cwd=REPO,
@@ -54,6 +58,58 @@ def test_two_process_pretrain_end_to_end(tmp_path):
     assert (save_dir / "epoch=1-cifar10").exists(), result.stderr[-2000:]
     # exactly one process logs (the reference's rank-0-only logging)
     assert result.stderr.count("Epoch:1/1") == 1, result.stderr[-2000:]
+
+
+def test_fail_fast_on_child_killed_mid_run(tmp_path):
+    """SIGKILL one child mid-training: the launcher must notice the dead
+    peer (even though the survivor blocks in a collective waiting for it)
+    and terminate the job, not hang — SURVEY §5.3's fail-fast contract."""
+    import signal
+    import time
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "simclr_tpu.launch",
+            "--nprocs", "2",
+            "--devices-per-proc", "1",
+            "--coordinator", "127.0.0.1:13361",
+            "-m", "simclr_tpu.main",
+            "parameter.epochs=50",  # long enough to still be running
+            "experiment.batches=8",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=50",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.save_dir={tmp_path / 'ckpts'}",
+        ],
+        cwd=REPO,
+        env=_launcher_env(),
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,  # its own process group, so we can find children
+    )
+    try:
+        # wait for both children to exist, then kill one
+        deadline = time.time() + 120
+        victim = None
+        while time.time() < deadline and victim is None:
+            pgid_procs = subprocess.run(
+                ["pgrep", "-g", str(proc.pid)], capture_output=True, text=True
+            ).stdout.split()
+            kids = [int(p) for p in pgid_procs if int(p) != proc.pid]
+            if len(kids) >= 2:
+                victim = kids[-1]
+            else:
+                time.sleep(0.5)
+        assert victim is not None, "children never appeared"
+        time.sleep(2)  # let them get into rendezvous/training
+        os.kill(victim, signal.SIGKILL)
+        rc = proc.wait(timeout=120)
+        assert rc != 0
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
 
 
 def test_fail_fast_on_child_failure():

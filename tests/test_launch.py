@@ -67,43 +67,53 @@ def test_fail_fast_on_child_killed_mid_run(tmp_path):
     import signal
     import time
 
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "simclr_tpu.launch",
-            "--nprocs", "2",
-            "--devices-per-proc", "1",
-            "--coordinator", "127.0.0.1:13361",
-            "-m", "simclr_tpu.main",
-            "parameter.epochs=50",  # long enough to still be running
-            "experiment.batches=8",
-            "parameter.warmup_epochs=0",
-            "experiment.save_model_epoch=50",
-            "experiment.synthetic_data=true",
-            "experiment.synthetic_size=64",
-            f"experiment.save_dir={tmp_path / 'ckpts'}",
-        ],
-        cwd=REPO,
-        env=_launcher_env(),
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-        start_new_session=True,  # its own process group, so we can find children
-    )
+    log_path = tmp_path / "launcher.log"
+    with open(log_path, "wb") as log:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "simclr_tpu.launch",
+                "--nprocs", "2",
+                "--devices-per-proc", "1",
+                "--coordinator", "127.0.0.1:13361",
+                "-m", "simclr_tpu.main",
+                "parameter.epochs=500",  # long enough to still be running
+                "experiment.batches=8",
+                "parameter.warmup_epochs=0",
+                "experiment.save_model_epoch=500",
+                "experiment.synthetic_data=true",
+                "experiment.synthetic_size=64",
+                f"experiment.save_dir={tmp_path / 'ckpts'}",
+            ],
+            cwd=REPO,
+            env=_launcher_env(),
+            stdout=log,
+            stderr=log,
+            start_new_session=True,  # its own process group, so we can find children
+        )
     try:
-        # wait for both children to exist, then kill one
-        deadline = time.time() + 120
-        victim = None
-        while time.time() < deadline and victim is None:
-            pgid_procs = subprocess.run(
-                ["pgrep", "-g", str(proc.pid)], capture_output=True, text=True
-            ).stdout.split()
-            kids = [int(p) for p in pgid_procs if int(p) != proc.pid]
-            if len(kids) >= 2:
-                victim = kids[-1]
-            else:
-                time.sleep(0.5)
-        assert victim is not None, "children never appeared"
-        time.sleep(2)  # let them get into rendezvous/training
-        os.kill(victim, signal.SIGKILL)
+        # wait until training has genuinely started (an epoch line logged) so
+        # the survivor is killed MID-TRAINING, inside/around a collective —
+        # not during import or rendezvous, which the config-failure test
+        # already covers
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            assert proc.poll() is None, (
+                f"launcher exited rc={proc.returncode} before training "
+                f"started:\n{log_path.read_text()[-2000:]}"
+            )
+            if b"Epoch:" in log_path.read_bytes():
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"training never started:\n{log_path.read_text()[-2000:]}"
+            )
+        pgid_procs = subprocess.run(
+            ["pgrep", "-g", str(proc.pid)], capture_output=True, text=True
+        ).stdout.split()
+        kids = [int(p) for p in pgid_procs if int(p) != proc.pid]
+        assert len(kids) >= 2, f"expected 2 children, found {kids}"
+        os.kill(kids[-1], signal.SIGKILL)
         rc = proc.wait(timeout=120)
         assert rc != 0
     finally:

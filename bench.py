@@ -45,12 +45,13 @@ REFERENCE_GPU_IMGS_PER_SEC = 4000.0  # estimated; see module docstring
 
 
 def main() -> None:
-    global PER_DEVICE_BATCH, TIMED_STEPS
+    global PER_DEVICE_BATCH, TIMED_STEPS, WARMUP_STEPS
     if jax.default_backend() == "cpu":
         # debug fallback only — the real benchmark runs on TPU; keep the CPU
         # path small enough to finish
-        PER_DEVICE_BATCH = 64
+        PER_DEVICE_BATCH = 16
         TIMED_STEPS = 5
+        WARMUP_STEPS = 2
     mesh = create_mesh()
     n_chips = mesh.size
     global_batch = PER_DEVICE_BATCH * mesh.shape[DATA_AXIS]

@@ -32,7 +32,7 @@ from simclr_tpu.config import (
     resolve_save_dir,
 )
 from simclr_tpu.data.cifar import load_dataset
-from simclr_tpu.data.pipeline import EpochIterator
+from simclr_tpu.data.pipeline import EpochIterator, epoch_permutation
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import ContrastiveModel
 from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
@@ -43,7 +43,7 @@ from simclr_tpu.parallel.mesh import (
     replicated_sharding,
     validate_per_device_batch,
 )
-from simclr_tpu.parallel.steps import make_pretrain_step
+from simclr_tpu.parallel.steps import make_pretrain_epoch_fn, make_pretrain_step
 from simclr_tpu.parallel.train_state import create_train_state, param_count
 from simclr_tpu.utils.checkpoint import (
     checkpoint_name,
@@ -92,6 +92,14 @@ def run_pretrain(cfg: Config) -> dict:
 
     # Reference step accounting (drop_last truncation, main.py:76-80)
     steps_per_epoch = len(dataset) // global_batch
+    if steps_per_epoch == 0:
+        # the per-step path raises this inside EpochIterator; the
+        # epoch-compiled path would otherwise run a zero-length scan and
+        # checkpoint untrained params
+        raise ValueError(
+            f"dataset of {len(dataset)} samples smaller than global batch "
+            f"{global_batch}"
+        )
     epochs = int(cfg.parameter.epochs)
     total_steps = epochs * steps_per_epoch
     warmup_steps = int(cfg.parameter.warmup_epochs) * steps_per_epoch
@@ -133,10 +141,7 @@ def run_pretrain(cfg: Config) -> dict:
             start_epoch = int(state.step) // max(steps_per_epoch, 1) + 1
             logger.info("Resumed from %s at epoch %d", ckpt, start_epoch)
 
-    step_fn = make_pretrain_step(
-        model,
-        tx,
-        mesh,
+    step_kwargs = dict(
         temperature=float(cfg.parameter.temperature),
         strength=float(cfg.experiment.strength),
         negatives=str(cfg.select("loss.negatives", "global")),
@@ -144,11 +149,28 @@ def run_pretrain(cfg: Config) -> dict:
         forward_mode=str(cfg.select("model.forward_mode", "two_pass")),
         remat=bool(cfg.select("model.remat", False)),
     )
+    epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     data_shard = batch_sharding(mesh)
-    iterator = EpochIterator(
-        dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
-        gather_threads=int(cfg.parameter.num_workers),
-    )
+    if epoch_compile:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "runtime.epoch_compile holds the replicated dataset on every "
+                "device of THIS process; use the per-step pipeline for "
+                "multi-host runs"
+            )
+        epoch_fn = make_pretrain_epoch_fn(model, tx, mesh, **step_kwargs)
+        # the whole uint8 dataset lives in HBM for the run; batches are
+        # gathered on device by shuffled index inside the epoch scan
+        images_all = jax.device_put(
+            jnp.asarray(dataset.images), replicated_sharding(mesh)
+        )
+        iterator = None
+    else:
+        step_fn = make_pretrain_step(model, tx, mesh, **step_kwargs)
+        iterator = EpochIterator(
+            dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
+            gather_threads=int(cfg.parameter.num_workers),
+        )
 
     if is_logging_host():
         os.makedirs(save_dir, exist_ok=True)
@@ -175,15 +197,31 @@ def run_pretrain(cfg: Config) -> dict:
     )
     t_start = time.time()
     # steady-state throughput, excluding the first (compiling) steps; the
-    # per-epoch log line reports the cumulative rate instead
-    timer = StepTimer(global_batch, warmup=3)
+    # per-epoch log line reports the cumulative rate instead. In
+    # epoch_compile mode one tick covers a whole epoch of steps.
+    timer = StepTimer(
+        global_batch * (steps_per_epoch if epoch_compile else 1),
+        warmup=1 if epoch_compile else 3,
+    )
     for epoch in range(start_epoch, epochs + 1):
-        for batch in prefetch(iterator.batches(epoch)):
-            tracer.tick(cur_step, pending=metrics["loss"])
-            step_rng = jax.random.fold_in(base_key, cur_step)
-            state, metrics = step_fn(state, batch["image"], step_rng)
-            timer.tick(metrics["loss"])
-            cur_step += 1
+        if epoch_compile:
+            order = epoch_permutation(len(dataset), seed, epoch)
+            idx_e = jnp.asarray(
+                order[: steps_per_epoch * global_batch]
+                .reshape(steps_per_epoch, global_batch)
+                .astype(np.int32)
+            )
+            state, losses = epoch_fn(state, images_all, idx_e, base_key, cur_step)
+            metrics = {"loss": losses[-1]}
+            timer.tick(losses)
+            cur_step += steps_per_epoch
+        else:
+            for batch in prefetch(iterator.batches(epoch)):
+                tracer.tick(cur_step, pending=metrics["loss"])
+                step_rng = jax.random.fold_in(base_key, cur_step)
+                state, metrics = step_fn(state, batch["image"], step_rng)
+                timer.tick(metrics["loss"])
+                cur_step += 1
         if is_logging_host():
             # one line per epoch, the reference's rank-0 log (main.py:124-127)
             lr_now = float(schedule(max(cur_step - 1, 0)))
@@ -207,10 +245,12 @@ def run_pretrain(cfg: Config) -> dict:
     tracer.close(pending=metrics["loss"])
     throughput = timer.summary()
     if is_logging_host() and throughput["steps"] > 0:
+        # in epoch_compile mode the timer ticks once per EPOCH; report steps
+        timed_steps = throughput["steps"] * (steps_per_epoch if epoch_compile else 1)
         logger.info(
             "steady-state: %.0f imgs/sec (%.0f per chip) over %d steps",
             throughput["imgs_per_sec"], throughput["imgs_per_sec_per_chip"],
-            throughput["steps"],
+            timed_steps,
         )
     return {
         "final_loss": float(metrics["loss"]),

@@ -108,32 +108,22 @@ def _apply_concat(fwd, params, batch_stats, v0, v1):
     return z[:n], z[n:], mut["batch_stats"]
 
 
-def make_pretrain_step(
+def _make_local_pretrain_step(
     model,
     tx: optax.GradientTransformation,
-    mesh,
     *,
-    temperature: float = 0.5,
-    strength: float = 0.5,
-    negatives: str = "global",
-    fused: bool = False,
-    forward_mode: str = "two_pass",
-    remat: bool = False,
-    out_size: int = 32,
-) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
-    """Build the jitted contrastive train step.
-
-    Returned callable: ``(state, images_u8, rng) -> (state, metrics)`` with
-    ``images`` the raw uint8 global batch sharded over the data axis. The
-    model must be constructed with ``bn_cross_replica_axis=DATA_AXIS``.
-
-    ``fused=True`` routes the loss through the Pallas blockwise kernels
-    (``ops/ntxent_pallas.py``), which never materialize the similarity
-    matrix — worthwhile at large (global) batches. Supported with ``local``
-    negatives (per-shard kernel) and ``global`` negatives (local anchors
-    against the all-gathered candidate set); ``ring`` IS the streaming
-    formulation already and has no fused variant.
-    """
+    temperature: float,
+    strength: float,
+    negatives: str,
+    fused: bool,
+    forward_mode: str,
+    remat: bool,
+    out_size: int,
+):
+    """The per-replica contrastive step, shared verbatim by the
+    dispatch-per-step (:func:`make_pretrain_step`) and epoch-compiled
+    (:func:`make_pretrain_epoch_fn`) paths so their numerics can never
+    diverge."""
     if negatives not in ("global", "local", "ring"):
         raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
     if forward_mode not in ("two_pass", "concat"):
@@ -178,10 +168,117 @@ def make_pretrain_step(
         metrics = {"loss": loss}
         return new_state, metrics
 
+    return local_step
+
+
+def make_pretrain_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    negatives: str = "global",
+    fused: bool = False,
+    forward_mode: str = "two_pass",
+    remat: bool = False,
+    out_size: int = 32,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
+    """Build the jitted contrastive train step.
+
+    Returned callable: ``(state, images_u8, rng) -> (state, metrics)`` with
+    ``images`` the raw uint8 global batch sharded over the data axis. The
+    model must be constructed with ``bn_cross_replica_axis=DATA_AXIS``.
+
+    ``fused=True`` routes the loss through the Pallas blockwise kernels
+    (``ops/ntxent_pallas.py``), which never materialize the similarity
+    matrix — worthwhile at large (global) batches. Supported with ``local``
+    negatives (per-shard kernel) and ``global`` negatives (local anchors
+    against the all-gathered candidate set); ``ring`` IS the streaming
+    formulation already and has no fused variant.
+    """
+    local_step = _make_local_pretrain_step(
+        model, tx,
+        temperature=temperature, strength=strength, negatives=negatives,
+        fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
+    )
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(_REP, _BATCH, _REP),
+        out_specs=_REP,
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_pretrain_epoch_fn(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    negatives: str = "global",
+    fused: bool = False,
+    forward_mode: str = "two_pass",
+    remat: bool = False,
+    out_size: int = 32,
+) -> Callable[..., tuple[TrainState, jnp.ndarray]]:
+    """Epoch-compiled training: one XLA program per EPOCH, zero host work
+    per step.
+
+    TPU-first design the reference cannot express: CIFAR fits in HBM (~150 MB
+    uint8), so the whole dataset lives ON DEVICE (replicated over the mesh)
+    and each step's shuffled global batch is gathered by index inside a
+    ``lax.scan`` over the epoch — no per-step ``device_put``, no dispatch
+    latency, no host jitter. The host's only per-epoch work is drawing the
+    shuffle permutation (a (steps, global_batch) int32 array) and reading the
+    loss history back.
+
+    Returned callable: ``(state, images_all, idx_epoch, base_key, step0) ->
+    (state, losses)`` where ``images_all`` is the full uint8 dataset
+    (replicated), ``idx_epoch`` is ``(steps, global_batch)`` int32 row
+    indices, ``base_key`` the run's PRNG key, and ``step0`` the global step
+    index of the epoch's first step. Per-step keys are derived as
+    ``fold_in(base_key, step0 + i)`` — identical to the per-step loop in
+    ``main.py``, so an epoch-compiled run consumes the same data order and
+    RNG streams and is numerically equivalent to the dispatch-per-step run
+    (test-asserted; exact bitwise equality is NOT guaranteed because XLA
+    fuses the scan body differently from the standalone step, reordering
+    bfloat16 roundings).
+    """
+    per_step = _make_local_pretrain_step(
+        model, tx,
+        temperature=temperature, strength=strength, negatives=negatives,
+        fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
+    )
+
+    def local_epoch(state: TrainState, images_all, idx_epoch, base_key, step0):
+        shard = jax.lax.axis_index(DATA_AXIS)
+        n_local = idx_epoch.shape[1] // jax.lax.axis_size(DATA_AXIS)
+
+        def body(carry, xs):
+            state = carry
+            idx_step, i = xs
+            local_idx = jax.lax.dynamic_slice_in_dim(
+                idx_step, shard * n_local, n_local
+            )
+            images = jnp.take(images_all, local_idx, axis=0)
+            state, metrics = per_step(
+                state, images, jax.random.fold_in(base_key, step0 + i)
+            )
+            return state, metrics["loss"]
+
+        steps = idx_epoch.shape[0]
+        return jax.lax.scan(
+            body, state, (idx_epoch, jnp.arange(steps, dtype=jnp.int32))
+        )
+
+    sharded = jax.shard_map(
+        local_epoch,
+        mesh=mesh,
+        in_specs=(_REP, _REP, _REP, _REP, _REP),
         out_specs=_REP,
         check_vma=False,
     )

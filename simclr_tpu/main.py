@@ -32,7 +32,7 @@ from simclr_tpu.config import (
     resolve_save_dir,
 )
 from simclr_tpu.data.cifar import load_dataset
-from simclr_tpu.data.pipeline import EpochIterator, epoch_permutation
+from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import ContrastiveModel
 from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
@@ -158,6 +158,11 @@ def run_pretrain(cfg: Config) -> dict:
                 "device of THIS process; use the per-step pipeline for "
                 "multi-host runs"
             )
+        if cfg.select("experiment.profile_dir"):
+            logger.warning(
+                "experiment.profile_dir is ignored with runtime.epoch_compile "
+                "(no per-step host boundary to bracket a trace window)"
+            )
         epoch_fn = make_pretrain_epoch_fn(model, tx, mesh, **step_kwargs)
         # the whole uint8 dataset lives in HBM for the run; batches are
         # gathered on device by shuffled index inside the epoch scan
@@ -205,15 +210,14 @@ def run_pretrain(cfg: Config) -> dict:
     )
     for epoch in range(start_epoch, epochs + 1):
         if epoch_compile:
-            order = epoch_permutation(len(dataset), seed, epoch)
             idx_e = jnp.asarray(
-                order[: steps_per_epoch * global_batch]
-                .reshape(steps_per_epoch, global_batch)
-                .astype(np.int32)
+                epoch_index_matrix(
+                    len(dataset), seed, epoch, steps_per_epoch, global_batch
+                )
             )
-            state, losses = epoch_fn(state, images_all, idx_e, base_key, cur_step)
-            metrics = {"loss": losses[-1]}
-            timer.tick(losses)
+            state, hist = epoch_fn(state, images_all, idx_e, base_key, cur_step)
+            metrics = {"loss": hist["loss"][-1]}
+            timer.tick(hist["loss"])
             cur_step += steps_per_epoch
         else:
             for batch in prefetch(iterator.batches(epoch)):

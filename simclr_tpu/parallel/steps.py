@@ -237,10 +237,10 @@ def make_pretrain_epoch_fn(
     loss history back.
 
     Returned callable: ``(state, images_all, idx_epoch, base_key, step0) ->
-    (state, losses)`` where ``images_all`` is the full uint8 dataset
-    (replicated), ``idx_epoch`` is ``(steps, global_batch)`` int32 row
-    indices, ``base_key`` the run's PRNG key, and ``step0`` the global step
-    index of the epoch's first step. Per-step keys are derived as
+    (state, {"loss": (steps,)})`` where ``images_all`` is the full uint8
+    dataset (replicated), ``idx_epoch`` is ``(steps, global_batch)`` int32
+    row indices, ``base_key`` the run's PRNG key, and ``step0`` the global
+    step index of the epoch's first step. Per-step keys are derived as
     ``fold_in(base_key, step0 + i)`` — identical to the per-step loop in
     ``main.py``, so an epoch-compiled run consumes the same data order and
     RNG streams and is numerically equivalent to the dispatch-per-step run
@@ -253,22 +253,34 @@ def make_pretrain_epoch_fn(
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
     )
+    return _make_epoch_fn(per_step, mesh, n_arrays=1)
 
-    def local_epoch(state: TrainState, images_all, idx_epoch, base_key, step0):
+
+def _make_epoch_fn(per_step, mesh, *, n_arrays: int):
+    """Wrap a per-replica step into the epoch ``lax.scan`` scaffolding.
+
+    Shared by the pretrain (images) and supervised (images, labels) epoch
+    paths so the SPMD mechanics — per-shard index slicing, on-device gather
+    of each replicated per-sample array, per-step key folding — exist once.
+    Returned callable: ``(state, *arrays, idx_epoch, base_key, step0) ->
+    (state, metrics_history)`` with each metrics leaf stacked to (steps,).
+    """
+
+    def local_epoch(state: TrainState, *rest):
+        arrays = rest[:n_arrays]
+        idx_epoch, base_key, step0 = rest[n_arrays:]
         shard = jax.lax.axis_index(DATA_AXIS)
         n_local = idx_epoch.shape[1] // jax.lax.axis_size(DATA_AXIS)
 
-        def body(carry, xs):
-            state = carry
+        def body(state, xs):
             idx_step, i = xs
             local_idx = jax.lax.dynamic_slice_in_dim(
                 idx_step, shard * n_local, n_local
             )
-            images = jnp.take(images_all, local_idx, axis=0)
-            state, metrics = per_step(
-                state, images, jax.random.fold_in(base_key, step0 + i)
+            gathered = [jnp.take(a, local_idx, axis=0) for a in arrays]
+            return per_step(
+                state, *gathered, jax.random.fold_in(base_key, step0 + i)
             )
-            return state, metrics["loss"]
 
         steps = idx_epoch.shape[0]
         return jax.lax.scan(
@@ -278,27 +290,16 @@ def make_pretrain_epoch_fn(
     sharded = jax.shard_map(
         local_epoch,
         mesh=mesh,
-        in_specs=(_REP, _REP, _REP, _REP, _REP),
+        in_specs=(_REP,) * (n_arrays + 4),
         out_specs=_REP,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_supervised_step(
-    model,
-    tx: optax.GradientTransformation,
-    mesh,
-    *,
-    strength: float = 0.5,
-    out_size: int = 32,
-) -> Callable[..., tuple[TrainState, Metrics]]:
-    """Jitted supervised CE train step (one SimCLR-augmented view).
-
-    The reference's supervised baseline trains on the single-view SimCLR
-    augmentation (``/root/reference/supervised.py:190,200`` uses
-    ``create_simclr_data_augmentation``) with CE loss (``supervised.py:104``).
-    """
+def _make_local_supervised_step(model, tx, *, strength: float, out_size: int):
+    """Per-replica supervised CE step, shared by the dispatch-per-step and
+    epoch-compiled paths (see :func:`_make_local_pretrain_step`)."""
 
     def local_step(state: TrainState, images, labels, rng):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
@@ -332,6 +333,26 @@ def make_supervised_step(
         )
         return new_state, {"loss": loss, "accuracy": acc}
 
+    return local_step
+
+
+def make_supervised_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> Callable[..., tuple[TrainState, Metrics]]:
+    """Jitted supervised CE train step (one SimCLR-augmented view).
+
+    The reference's supervised baseline trains on the single-view SimCLR
+    augmentation (``/root/reference/supervised.py:190,200`` uses
+    ``create_simclr_data_augmentation``) with CE loss (``supervised.py:104``).
+    """
+    local_step = _make_local_supervised_step(
+        model, tx, strength=strength, out_size=out_size
+    )
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
@@ -340,6 +361,28 @@ def make_supervised_step(
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_supervised_epoch_fn(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> Callable[..., tuple[TrainState, Metrics]]:
+    """Epoch-compiled supervised training (see
+    :func:`make_pretrain_epoch_fn` — same design: dataset resident on
+    device, per-epoch ``lax.scan``, identical RNG streams to the per-step
+    loop).
+
+    Returned callable: ``(state, images_all, labels_all, idx_epoch,
+    base_key, step0) -> (state, {"loss": (steps,), "accuracy": (steps,)})``.
+    """
+    per_step = _make_local_supervised_step(
+        model, tx, strength=strength, out_size=out_size
+    )
+    return _make_epoch_fn(per_step, mesh, n_arrays=2)
 
 
 def make_supervised_eval_step(model, mesh) -> Callable[..., Metrics]:

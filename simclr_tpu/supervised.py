@@ -24,7 +24,7 @@ import optax
 
 from simclr_tpu.config import Config, check_supervised_conf, load_config, resolve_save_dir
 from simclr_tpu.data.cifar import NUM_CLASSES, load_dataset
-from simclr_tpu.data.pipeline import EpochIterator
+from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import SupervisedModel
 from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
@@ -35,7 +35,11 @@ from simclr_tpu.parallel.mesh import (
     replicated_sharding,
     validate_per_device_batch,
 )
-from simclr_tpu.parallel.steps import make_supervised_eval_step, make_supervised_step
+from simclr_tpu.parallel.steps import (
+    make_supervised_epoch_fn,
+    make_supervised_eval_step,
+    make_supervised_step,
+)
 from simclr_tpu.parallel.train_state import create_train_state, param_count
 from simclr_tpu.utils.checkpoint import checkpoint_name, delete_checkpoint, save_checkpoint
 from simclr_tpu.utils.logging import get_logger, is_logging_host
@@ -107,15 +111,44 @@ def run_supervised(cfg: Config) -> dict:
     )
     state = jax.device_put(state, replicated_sharding(mesh))
 
-    train_step = make_supervised_step(
-        model, tx, mesh, strength=float(cfg.experiment.strength)
-    )
+    epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     eval_step = make_supervised_eval_step(model, mesh)
     data_shard = batch_sharding(mesh)
-    train_iter = EpochIterator(
-        train_ds, global_batch, seed=seed, shuffle=True, sharding=data_shard,
-        gather_threads=int(cfg.parameter.num_workers),
-    )
+    if epoch_compile:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "runtime.epoch_compile holds the replicated dataset on every "
+                "device of THIS process; use the per-step pipeline for "
+                "multi-host runs"
+            )
+        if steps_per_epoch == 0:
+            raise ValueError(
+                f"dataset of {len(train_ds)} samples smaller than global "
+                f"batch {global_batch}"
+            )
+        if cfg.select("experiment.profile_dir"):
+            logger.warning(
+                "experiment.profile_dir is ignored with runtime.epoch_compile "
+                "(no per-step host boundary to bracket a trace window)"
+            )
+        epoch_fn = make_supervised_epoch_fn(
+            model, tx, mesh, strength=float(cfg.experiment.strength)
+        )
+        images_all = jax.device_put(
+            jnp.asarray(train_ds.images), replicated_sharding(mesh)
+        )
+        labels_all = jax.device_put(
+            jnp.asarray(train_ds.labels), replicated_sharding(mesh)
+        )
+        train_iter = None
+    else:
+        train_step = make_supervised_step(
+            model, tx, mesh, strength=float(cfg.experiment.strength)
+        )
+        train_iter = EpochIterator(
+            train_ds, global_batch, seed=seed, shuffle=True, sharding=data_shard,
+            gather_threads=int(cfg.parameter.num_workers),
+        )
     # validation: no shuffle, keep every sample (reference drop_last=False,
     # supervised.py:219-223). Tail remainder is evaluated in a host-side pass.
     val_steps = len(val_ds) // global_batch
@@ -146,13 +179,25 @@ def run_supervised(cfg: Config) -> dict:
     )
     for epoch in range(1, epochs + 1):
         train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
-        for batch in prefetch(train_iter.batches(epoch)):
-            tracer.tick(cur_step, pending=train_metrics["loss"])
-            step_rng = jax.random.fold_in(base_key, cur_step)
-            state, train_metrics = train_step(
-                state, batch["image"], batch["label"], step_rng
+        if epoch_compile:
+            idx_e = jnp.asarray(
+                epoch_index_matrix(
+                    len(train_ds), seed, epoch, steps_per_epoch, global_batch
+                )
             )
-            cur_step += 1
+            state, epoch_metrics = epoch_fn(
+                state, images_all, labels_all, idx_e, base_key, cur_step
+            )
+            train_metrics = {k: v[-1] for k, v in epoch_metrics.items()}
+            cur_step += steps_per_epoch
+        else:
+            for batch in prefetch(train_iter.batches(epoch)):
+                tracer.tick(cur_step, pending=train_metrics["loss"])
+                step_rng = jax.random.fold_in(base_key, cur_step)
+                state, train_metrics = train_step(
+                    state, batch["image"], batch["label"], step_rng
+                )
+                cur_step += 1
 
         # distributed validation (reference supervised.py:30-58,135-139)
         sum_loss, correct, count = 0.0, 0.0, 0.0

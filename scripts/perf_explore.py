@@ -1,0 +1,135 @@
+"""Perf exploration on real TPU: time pretrain-step variants at batch 512.
+
+Compares forward_mode (two_pass vs concat), fused Pallas NT-Xent, remat,
+and epoch-compiled scan against the bench.py default, all with value-fetch
+synchronization (see bench.py's measurement-integrity note). Prints one JSON
+line per variant. Not part of the driver bench contract — a tuning tool.
+
+Usage: python scripts/perf_explore.py [--steps 100] [--batch 512]
+       [--variants two_pass,concat,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_tpu.data.cifar import synthetic_dataset
+from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+from simclr_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    create_mesh,
+    replicated_sharding,
+)
+from simclr_tpu.parallel.steps import make_pretrain_epoch_fn, make_pretrain_step
+from simclr_tpu.parallel.train_state import create_train_state
+from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
+
+VARIANTS = {
+    # name -> kwargs for make_pretrain_step
+    "two_pass": dict(forward_mode="two_pass"),
+    "concat": dict(forward_mode="concat"),
+    "two_pass_fused": dict(forward_mode="two_pass", fused=True),
+    "concat_fused": dict(forward_mode="concat", fused=True),
+    "two_pass_remat": dict(forward_mode="two_pass", remat=True),
+    "epoch_compile": dict(forward_mode="two_pass"),  # scan path, see below
+}
+
+
+def build_state(model, tx, mesh):
+    state = create_train_state(
+        model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
+    )
+    return jax.device_put(state, replicated_sharding(mesh))
+
+
+def time_stepwise(step, state, batches, rng, warmup, steps):
+    for i in range(warmup):
+        state, metrics = step(state, batches[i % len(batches)], rng)
+    float(metrics["loss"])  # drain the queue before starting the clock
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, batches[i % len(batches)], rng)
+    loss = float(metrics["loss"])  # value fetch = reliable fence
+    dt = time.perf_counter() - t0
+    return dt, loss
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--warmup", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=512, help="per-device batch")
+    ap.add_argument("--variants", type=str, default=",".join(VARIANTS))
+    args = ap.parse_args()
+
+    mesh = create_mesh()
+    n_data = mesh.shape[DATA_AXIS]
+    global_batch = args.batch * n_data
+    model = ContrastiveModel(
+        base_cnn="resnet18", d=128, bn_cross_replica_axis=DATA_AXIS
+    )
+    lr0 = calculate_initial_lr(1.0, args.batch, True)
+    schedule = warmup_cosine_schedule(lr0, total_steps=100_000, warmup_steps=10)
+    tx = lars(schedule, weight_decay=1e-4, weight_decay_mask=simclr_weight_decay_mask)
+
+    ds = synthetic_dataset("cifar10", "train", size=global_batch * 2)
+    sharding = batch_sharding(mesh)
+    batches = [
+        jax.device_put(ds.images[i * global_batch : (i + 1) * global_batch], sharding)
+        for i in range(2)
+    ]
+    rng = jax.random.key(0)
+
+    for name in args.variants.split(","):
+        kw = VARIANTS[name]
+        state = build_state(model, tx, mesh)
+        if name == "epoch_compile":
+            epoch_fn = make_pretrain_epoch_fn(
+                model, tx, mesh, temperature=0.5, strength=0.5,
+                negatives="global", **kw,
+            )
+            images_all = jax.device_put(ds.images, replicated_sharding(mesh))
+            n = ds.images.shape[0]
+            steps_per_epoch = args.steps
+            idx = np.random.default_rng(0).integers(
+                0, n, size=(steps_per_epoch, global_batch), dtype=np.int32
+            )
+            idx_d = jax.device_put(jnp.asarray(idx), replicated_sharding(mesh))
+            # warmup epoch (compile) then timed epoch
+            state, hist = epoch_fn(state, images_all, idx_d, rng, jnp.int32(0))
+            float(hist["loss"][-1])
+            t0 = time.perf_counter()
+            state, hist = epoch_fn(state, images_all, idx_d, rng, jnp.int32(0))
+            loss = float(hist["loss"][-1])
+            dt = time.perf_counter() - t0
+        else:
+            step = make_pretrain_step(
+                model, tx, mesh, temperature=0.5, strength=0.5,
+                negatives="global", **kw,
+            )
+            dt, loss = time_stepwise(
+                step, state, batches, rng, args.warmup, args.steps
+            )
+        rate = args.steps * global_batch / dt / mesh.size
+        print(json.dumps({
+            "variant": name,
+            "imgs_per_sec_per_chip": round(rate, 1),
+            "ms_per_step": round(dt / args.steps * 1e3, 2),
+            "final_loss": round(loss, 4),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

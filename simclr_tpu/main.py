@@ -43,7 +43,11 @@ from simclr_tpu.parallel.mesh import (
     replicated_sharding,
     validate_per_device_batch,
 )
-from simclr_tpu.parallel.steps import make_pretrain_epoch_fn, make_pretrain_step
+from simclr_tpu.parallel.steps import (
+    check_epoch_compile_preconditions,
+    make_pretrain_epoch_fn,
+    make_pretrain_step,
+)
 from simclr_tpu.parallel.train_state import create_train_state, param_count
 from simclr_tpu.utils.checkpoint import (
     checkpoint_name,
@@ -93,9 +97,8 @@ def run_pretrain(cfg: Config) -> dict:
     # Reference step accounting (drop_last truncation, main.py:76-80)
     steps_per_epoch = len(dataset) // global_batch
     if steps_per_epoch == 0:
-        # the per-step path raises this inside EpochIterator; the
-        # epoch-compiled path would otherwise run a zero-length scan and
-        # checkpoint untrained params
+        # early, before any compile; check_epoch_compile_preconditions and
+        # EpochIterator repeat this at their own boundaries
         raise ValueError(
             f"dataset of {len(dataset)} samples smaller than global batch "
             f"{global_batch}"
@@ -152,17 +155,9 @@ def run_pretrain(cfg: Config) -> dict:
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     data_shard = batch_sharding(mesh)
     if epoch_compile:
-        if jax.process_count() > 1:
-            raise ValueError(
-                "runtime.epoch_compile holds the replicated dataset on every "
-                "device of THIS process; use the per-step pipeline for "
-                "multi-host runs"
-            )
-        if cfg.select("experiment.profile_dir"):
-            logger.warning(
-                "experiment.profile_dir is ignored with runtime.epoch_compile "
-                "(no per-step host boundary to bracket a trace window)"
-            )
+        check_epoch_compile_preconditions(
+            len(dataset), global_batch, cfg.select("experiment.profile_dir")
+        )
         epoch_fn = make_pretrain_epoch_fn(model, tx, mesh, **step_kwargs)
         # the whole uint8 dataset lives in HBM for the run; batches are
         # gathered on device by shuffled index inside the epoch scan

@@ -57,6 +57,39 @@ _REP = P()          # replicated
 _BATCH = P(DATA_AXIS)  # batch dim sharded over the data axis
 
 
+def check_epoch_compile_preconditions(
+    n_samples: int, global_batch: int, profile_dir=None
+) -> None:
+    """Shared ``runtime.epoch_compile`` preflight for the entry points.
+
+    The epoch-compiled path replicates the whole dataset into the HBM of
+    THIS process's devices and has no per-step host boundary, so it is
+    single-host only and cannot bracket a profiler trace window around
+    individual steps. Raising here (rather than per entry point) keeps
+    ``main.py`` and ``supervised.py`` in lockstep.
+    """
+    if jax.process_count() > 1:
+        raise ValueError(
+            "runtime.epoch_compile holds the replicated dataset on every "
+            "device of THIS process; use the per-step pipeline for "
+            "multi-host runs"
+        )
+    if n_samples < global_batch:
+        # the per-step path raises this inside EpochIterator; here it would
+        # otherwise run a zero-length scan and checkpoint untrained params
+        raise ValueError(
+            f"dataset of {n_samples} samples smaller than global batch "
+            f"{global_batch}"
+        )
+    if profile_dir:
+        from simclr_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "experiment.profile_dir is ignored with runtime.epoch_compile "
+            "(no per-step host boundary to bracket a trace window)"
+        )
+
+
 def _augment_two_views(rng, images, strength, out_size):
     """Two on-device SimCLR views of the local uint8 shard."""
     n = images.shape[0]
@@ -224,7 +257,7 @@ def make_pretrain_epoch_fn(
     forward_mode: str = "two_pass",
     remat: bool = False,
     out_size: int = 32,
-) -> Callable[..., tuple[TrainState, jnp.ndarray]]:
+) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled training: one XLA program per EPOCH, zero host work
     per step.
 

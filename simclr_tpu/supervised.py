@@ -36,6 +36,7 @@ from simclr_tpu.parallel.mesh import (
     validate_per_device_batch,
 )
 from simclr_tpu.parallel.steps import (
+    check_epoch_compile_preconditions,
     make_supervised_epoch_fn,
     make_supervised_eval_step,
     make_supervised_step,
@@ -115,22 +116,9 @@ def run_supervised(cfg: Config) -> dict:
     eval_step = make_supervised_eval_step(model, mesh)
     data_shard = batch_sharding(mesh)
     if epoch_compile:
-        if jax.process_count() > 1:
-            raise ValueError(
-                "runtime.epoch_compile holds the replicated dataset on every "
-                "device of THIS process; use the per-step pipeline for "
-                "multi-host runs"
-            )
-        if steps_per_epoch == 0:
-            raise ValueError(
-                f"dataset of {len(train_ds)} samples smaller than global "
-                f"batch {global_batch}"
-            )
-        if cfg.select("experiment.profile_dir"):
-            logger.warning(
-                "experiment.profile_dir is ignored with runtime.epoch_compile "
-                "(no per-step host boundary to bracket a trace window)"
-            )
+        check_epoch_compile_preconditions(
+            len(train_ds), global_batch, cfg.select("experiment.profile_dir")
+        )
         epoch_fn = make_supervised_epoch_fn(
             model, tx, mesh, strength=float(cfg.experiment.strength)
         )

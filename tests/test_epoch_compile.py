@@ -134,3 +134,14 @@ def test_epoch_compile_entrypoint(tmp_path):
     assert summary["steps"] == 2 * (64 // (4 * 8))
     assert np.isfinite(summary["final_loss"])
     assert (tmp_path / "epoch=2-cifar10").exists()
+
+
+def test_epoch_compile_preconditions():
+    import pytest
+
+    from simclr_tpu.parallel.steps import check_epoch_compile_preconditions
+
+    # single-process, dataset >= one global batch: fine
+    check_epoch_compile_preconditions(64, 32)
+    with pytest.raises(ValueError, match="smaller than global batch"):
+        check_epoch_compile_preconditions(16, 32)

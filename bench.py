@@ -5,63 +5,159 @@ ResNet-18 forwards, global-negative NT-Xent, backward, psum, LARS — at the
 reference recipe's per-device batch 512, and prints ONE JSON line:
 
     {"metric": "pretrain_imgs_per_sec_per_chip", "value": ..., "unit":
-     "imgs/sec/chip", "vs_baseline": ...}
+     "imgs/sec/chip", "vs_baseline": ..., "backend": "tpu"|"cpu", ...}
 
 ``vs_baseline``: the reference publishes NO throughput numbers (SURVEY §6 —
-its README tables are accuracy-only), so the denominator is an estimate of
+its README tables are accuracy-only), so the denominator is an *estimate* of
 the reference stack's per-GPU rate for this exact workload (PyTorch DDP
 ResNet-18, CIFAR batch 512/GPU, two forward passes + NT-Xent) on a V100:
 ~4000 imgs/sec/GPU. vs_baseline > 1 means one TPU chip outruns one reference
-GPU on the same recipe.
+GPU on the same recipe. The emitted JSON carries ``baseline_estimated: true``
+so downstream consumers see the caveat without reading this docstring.
+
+Robustness contract (VERDICT round 1, item 1): this script NEVER exits
+nonzero and NEVER prints a traceback as its last line. The TPU tunnel in
+this environment is known to hang indefinitely (even a 256x256 matmul can
+block forever, and killing the hung client does not free the device), so:
+
+  * the parent process imports no JAX at all — it only orchestrates;
+  * the TPU is first probed by a small timed matmul in a subprocess with a
+    hard timeout, retried with backoff;
+  * the measurement itself runs in a subprocess with a hard timeout;
+  * any failure (backend init error, hang, crash) falls back to a CPU-backend
+    measurement, and if even that fails the parent emits a JSON line with
+    ``"backend": "none"`` and the error — ``parsed`` is never null.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from simclr_tpu.data.cifar import synthetic_dataset
-from simclr_tpu.models.contrastive import ContrastiveModel
-from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
-from simclr_tpu.parallel.mesh import DATA_AXIS, batch_sharding, create_mesh, replicated_sharding
-from simclr_tpu.parallel.steps import make_pretrain_step
-from simclr_tpu.parallel.train_state import create_train_state
-from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
-
 PER_DEVICE_BATCH = 512  # reference conf/experiment/cifar10.yaml:10
-# Timing must end with an actual device->host VALUE fetch (float(loss)), not
-# just block_until_ready: on remote-tunneled runtimes the latter can return
-# before the dispatch queue drains, inflating short-window rates by >10x.
-# The window is also long (200 steps, ~6s of device time) so that queueing
-# effects at the margin are amortized; measured rate is then within ~2% of
-# the fully-synchronous per-step rate.
 WARMUP_STEPS = 10
 TIMED_STEPS = 200
 REFERENCE_GPU_IMGS_PER_SEC = 4000.0  # estimated; see module docstring
 
+PROBE_TIMEOUT_S = 150  # first TPU compile through the tunnel is ~20-40s
+PROBE_ATTEMPTS = 2
+PROBE_BACKOFF_S = 20
+TPU_BENCH_TIMEOUT_S = 900
+CPU_BENCH_TIMEOUT_S = 900
 
-def main() -> None:
-    global PER_DEVICE_BATCH, TIMED_STEPS, WARMUP_STEPS
+_PROBE_SRC = """
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = float((x @ x).sum())  # VALUE fetch: block_until_ready lies through the tunnel
+assert v > 0
+print("PROBE_OK", jax.default_backend(), len(jax.devices()))
+"""
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def probe_tpu() -> bool:
+    """Can the TPU backend init and execute a matmul within the timeout?"""
+    for attempt in range(PROBE_ATTEMPTS):
+        if attempt:
+            time.sleep(PROBE_BACKOFF_S)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            print(f"# TPU probe attempt {attempt + 1}: timed out", file=sys.stderr)
+            continue
+        if r.returncode == 0 and "PROBE_OK" in r.stdout and "cpu" not in r.stdout:
+            return True
+        print(
+            f"# TPU probe attempt {attempt + 1}: rc={r.returncode} "
+            f"out={r.stdout.strip()[-200:]} err={r.stderr.strip()[-200:]}",
+            file=sys.stderr,
+        )
+    return False
+
+
+def _run_measurement(backend: str, timeout_s: int):
+    """Run this file in --worker mode in a subprocess; return parsed JSON or None."""
+    env = _cpu_env() if backend == "cpu" else dict(os.environ)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", backend],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# {backend} measurement timed out after {timeout_s}s", file=sys.stderr)
+        return None
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in parsed and "error" not in parsed:
+                return parsed
+    print(
+        f"# {backend} measurement rc={r.returncode}, no JSON; "
+        f"stderr tail: {r.stderr.strip()[-500:]}",
+        file=sys.stderr,
+    )
+    return None
+
+
+def worker(backend: str) -> None:
+    """The actual measurement (runs in a subprocess; may crash/hang freely)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from simclr_tpu.data.cifar import synthetic_dataset
+    from simclr_tpu.models.contrastive import ContrastiveModel
+    from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+    from simclr_tpu.parallel.mesh import (
+        DATA_AXIS,
+        batch_sharding,
+        create_mesh,
+        replicated_sharding,
+    )
+    from simclr_tpu.parallel.steps import make_pretrain_step
+    from simclr_tpu.parallel.train_state import create_train_state
+    from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
+
+    per_device_batch, timed_steps, warmup_steps = (
+        PER_DEVICE_BATCH,
+        TIMED_STEPS,
+        WARMUP_STEPS,
+    )
     if jax.default_backend() == "cpu":
         # debug fallback only — the real benchmark runs on TPU; keep the CPU
-        # path small enough to finish
-        PER_DEVICE_BATCH = 16
-        TIMED_STEPS = 5
-        WARMUP_STEPS = 2
+        # path small enough to finish on a single host core
+        per_device_batch, timed_steps, warmup_steps = 16, 5, 2
+
     mesh = create_mesh()
     n_chips = mesh.size
-    global_batch = PER_DEVICE_BATCH * mesh.shape[DATA_AXIS]
+    global_batch = per_device_batch * mesh.shape[DATA_AXIS]
 
     model = ContrastiveModel(base_cnn="resnet18", d=128, bn_cross_replica_axis=DATA_AXIS)
-    lr0 = calculate_initial_lr(1.0, PER_DEVICE_BATCH, True)
+    lr0 = calculate_initial_lr(1.0, per_device_batch, True)
     schedule = warmup_cosine_schedule(lr0, total_steps=1000, warmup_steps=10)
-    tx = lars(
-        schedule, weight_decay=1e-4, weight_decay_mask=simclr_weight_decay_mask
-    )
+    tx = lars(schedule, weight_decay=1e-4, weight_decay_mask=simclr_weight_decay_mask)
     state = create_train_state(
         model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
     )
@@ -77,18 +173,23 @@ def main() -> None:
         for i in range(2)
     ]
 
+    # Timing must end with an actual device->host VALUE fetch (float(loss)),
+    # not just block_until_ready: on remote-tunneled runtimes the latter can
+    # return before the dispatch queue drains, inflating short-window rates by
+    # >10x. The window is also long (200 steps, ~6s of device time) so that
+    # queueing effects at the margin are amortized.
     rng = jax.random.key(0)
-    for i in range(WARMUP_STEPS):
+    for i in range(warmup_steps):
         state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, i))
-    float(metrics["loss"])  # drain the dispatch queue (see timing note above)
+    float(metrics["loss"])  # drain the dispatch queue
 
     t0 = time.perf_counter()
-    for i in range(TIMED_STEPS):
+    for i in range(timed_steps):
         state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, 100 + i))
     final_loss = float(metrics["loss"])  # value fetch = true synchronization
     dt = time.perf_counter() - t0
 
-    imgs_per_sec = TIMED_STEPS * global_batch / dt
+    imgs_per_sec = timed_steps * global_batch / dt
     per_chip = imgs_per_sec / n_chips
     assert np.isfinite(final_loss)
     print(
@@ -98,10 +199,61 @@ def main() -> None:
                 "value": round(per_chip, 1),
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(per_chip / REFERENCE_GPU_IMGS_PER_SEC, 3),
+                "backend": jax.default_backend(),
+                "n_chips": n_chips,
+                "per_device_batch": per_device_batch,
+                "timed_steps": timed_steps,
+                "baseline_estimated": True,
+                "baseline_note": "denominator 4000 imgs/sec is an estimated "
+                "V100 rate; reference publishes no throughput (SURVEY §6)",
             }
         )
     )
 
 
+def main() -> None:
+    result = None
+    if probe_tpu():
+        result = _run_measurement("tpu", TPU_BENCH_TIMEOUT_S)
+    if result is None:
+        print("# falling back to CPU backend", file=sys.stderr)
+        result = _run_measurement("cpu", CPU_BENCH_TIMEOUT_S)
+    if result is None:
+        result = {
+            "metric": "pretrain_imgs_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "imgs/sec/chip",
+            "vs_baseline": 0.0,
+            "backend": "none",
+            "baseline_estimated": True,
+            "error": "both TPU and CPU measurements failed; see stderr",
+        }
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        # worker mode: crash freely (nonzero rc / traceback) so the parent's
+        # _run_measurement sees the failure and falls back — the last-ditch
+        # JSON below is for the ORCHESTRATOR only, else a crashed TPU worker
+        # would masquerade as a valid measurement and skip the CPU fallback
+        worker(sys.argv[2])
+        sys.exit(0)
+    try:
+        main()
+    except Exception as exc:  # pragma: no cover — last-ditch contract keeper
+        print(f"# unexpected orchestrator error: {exc!r}", file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "pretrain_imgs_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "imgs/sec/chip",
+                    "vs_baseline": 0.0,
+                    "backend": "none",
+                    "baseline_estimated": True,
+                    "error": repr(exc),
+                }
+            )
+        )
+    sys.exit(0)

@@ -57,7 +57,7 @@ def build_state(model, tx, mesh):
 def time_stepwise(step, state, batches, rng, warmup, steps):
     for i in range(warmup):
         state, metrics = step(state, batches[i % len(batches)], rng)
-    float(metrics["loss"])  # drain the queue before starting the clock
+        float(metrics["loss"])  # drain the queue before starting the clock
     t0 = time.perf_counter()
     for i in range(steps):
         state, metrics = step(state, batches[i % len(batches)], rng)

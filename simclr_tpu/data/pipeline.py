@@ -25,6 +25,7 @@ import numpy as np
 
 from simclr_tpu.data.cifar import Dataset
 from simclr_tpu.native.lib import DEFAULT_THREADS, gather_rows2
+from simclr_tpu.parallel.mesh import put_global_batch
 
 
 def epoch_permutation(num_samples: int, seed: int, epoch: int) -> np.ndarray:
@@ -130,8 +131,4 @@ class EpochIterator:
             yield batch
 
     def _to_device(self, array: np.ndarray, name: str) -> jax.Array:
-        sharding = self.sharding
-        if jax.process_count() > 1:
-            global_shape = (array.shape[0] * jax.process_count(), *array.shape[1:])
-            return jax.make_array_from_process_local_data(sharding, array, global_shape)
-        return jax.device_put(array, sharding)
+        return put_global_batch(array, self.sharding)

@@ -47,6 +47,8 @@ from simclr_tpu.models.heads import (
 from simclr_tpu.parallel.mesh import (
     batch_sharding,
     mesh_from_config,
+    process_local_rows,
+    put_global_batch,
     validate_per_device_batch,
 )
 from simclr_tpu.parallel.steps import make_encode_step
@@ -93,9 +95,11 @@ def extract_features(
     pad = steps * batch - n
     if pad:
         images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+    local = process_local_rows(batch)  # every host holds the full split;
+    # upload only this process's row block of each chunk (multi-host safe)
     outs = []
     for i in range(steps):
-        chunk = jax.device_put(images[i * batch : (i + 1) * batch], sharding)
+        chunk = put_global_batch(images[i * batch : (i + 1) * batch][local], sharding)
         outs.append(_fetch(encode(variables["params"], variables["batch_stats"], chunk)))
     return np.concatenate(outs)[:n]
 

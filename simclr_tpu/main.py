@@ -35,7 +35,7 @@ from simclr_tpu.data.cifar import load_dataset
 from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import ContrastiveModel
-from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+from simclr_tpu.ops.lars import get_weight_decay_mask, lars
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
@@ -125,7 +125,10 @@ def run_pretrain(cfg: Config) -> dict:
         schedule,
         trust_coefficient=0.001,
         weight_decay=float(cfg.experiment.decay),
-        weight_decay_mask=simclr_weight_decay_mask,
+        weight_decay_mask=get_weight_decay_mask(
+            str(cfg.select("optimizer.weight_decay_mask", "structural")),
+            str(cfg.experiment.base_cnn),
+        ),
         momentum=float(cfg.parameter.momentum),
     )
 

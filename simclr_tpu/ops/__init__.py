@@ -1,4 +1,10 @@
-from simclr_tpu.ops.lars import lars, scale_by_larc, simclr_weight_decay_mask
+from simclr_tpu.ops.lars import (
+    get_weight_decay_mask,
+    lars,
+    reference_weight_decay_mask,
+    scale_by_larc,
+    simclr_weight_decay_mask,
+)
 from simclr_tpu.ops.ntxent import (
     gather_global_candidates,
     ntxent_loss,
@@ -16,6 +22,8 @@ __all__ = [
     "lars",
     "scale_by_larc",
     "simclr_weight_decay_mask",
+    "reference_weight_decay_mask",
+    "get_weight_decay_mask",
     "gather_global_candidates",
     "ntxent_loss",
     "ntxent_loss_local_negatives",

@@ -94,6 +94,53 @@ def lars(
     )
 
 
+def reference_weight_decay_mask(params, base_cnn: str = "resnet18") -> Any:
+    """The reference's ``("bias", "bn")`` name-substring skip rule
+    (``/root/reference/main.py:18-36``) transcribed onto our tree — quirks
+    included: torchvision's downsample batch-norm scale (torch name
+    ``...downsample.1.weight``) and the projection head's batch-norm scale
+    (``g.projection_head.1.weight``) contain neither substring, so the
+    reference DOES weight-decay them. Biases never decay (every torch bias
+    name contains "bias").
+
+    For training-dynamics parity runs (tests/test_torch_dynamics.py);
+    :func:`simclr_weight_decay_mask` remains the default documented intent.
+    Select with ``optimizer.weight_decay_mask=reference``.
+    """
+    downsample_bn = f"BatchNorm_{ {'resnet18': 2, 'resnet50': 3}[base_cnn] }"
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def decide(path) -> bool:
+        names = [str(p.key) for p in path if isinstance(p, jax.tree_util.DictKey)]
+        leaf = names[-1]
+        if leaf == "bias":
+            return False
+        if leaf == "scale":
+            # f/<Block_i>/BatchNorm_{n_convs} is the projection-shortcut BN
+            # (torch downsample.1); g/bn1 is the head BN — both decayed there
+            if len(names) >= 3 and names[-2] == downsample_bn and names[0] == "f":
+                return True
+            return names[0] == "g" and names[-2] == "bn1"
+        return True
+
+    decisions = [decide(path) for path, _ in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, decisions)
+
+
+def get_weight_decay_mask(kind: str, base_cnn: str = "resnet18") -> Callable[[Any], Any]:
+    """Mask selection for the ``optimizer.weight_decay_mask`` config key:
+    ``structural`` (default, documented intent) or ``reference`` (the torch
+    substring rule, quirks included — for exact-recipe parity runs)."""
+    if kind == "structural":
+        return simclr_weight_decay_mask
+    if kind == "reference":
+        return lambda params: reference_weight_decay_mask(params, base_cnn)
+    raise ValueError(
+        f"optimizer.weight_decay_mask must be structural|reference, got {kind!r}"
+    )
+
+
 def simclr_weight_decay_mask(params) -> Any:
     """True where weight decay applies: everything except biases and norm
     scales — the reference's ("bias", "bn") skip list by structure rather

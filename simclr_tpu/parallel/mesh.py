@@ -97,6 +97,42 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def put_global_batch(local_rows: np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """Host-local batch rows -> globally batch-sharded device array.
+
+    The multi-host-safe replacement for ``jax.device_put(x, batch_sharding)``:
+    a process cannot ``device_put`` onto a sharding that spans devices it does
+    not address. Every process passes its own contiguous row block (process
+    ``p`` holds global rows ``[p*k, (p+1)*k)``, the convention shared with
+    ``data.pipeline.EpochIterator``), and the global array is assembled with
+    ``make_array_from_process_local_data``. Single-process: a plain
+    ``device_put``. Inverse of ``multihost_utils.process_allgather(tiled=True)``.
+    """
+    if jax.process_count() > 1:
+        global_shape = (local_rows.shape[0] * jax.process_count(), *local_rows.shape[1:])
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(local_rows), global_shape
+        )
+    return jax.device_put(local_rows, sharding)
+
+
+def process_local_rows(n_global_rows: int) -> slice:
+    """This process's contiguous row block of a batch of ``n_global_rows``.
+
+    Pairs with :func:`put_global_batch`: ``put_global_batch(x[process_local_rows
+    (len(x))], s)`` uploads a host-replicated array ``x`` as a globally
+    batch-sharded one.
+    """
+    n_proc = jax.process_count()
+    if n_global_rows % n_proc:
+        raise ValueError(
+            f"batch of {n_global_rows} rows not divisible by {n_proc} processes"
+        )
+    per_proc = n_global_rows // n_proc
+    start = jax.process_index() * per_proc
+    return slice(start, start + per_proc)
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     n_data = mesh.shape[DATA_AXIS]
     if global_batch % n_data:

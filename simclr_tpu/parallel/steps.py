@@ -62,14 +62,25 @@ def check_epoch_compile_preconditions(
 ) -> None:
     """Shared ``runtime.epoch_compile`` preflight for the entry points.
 
-    The epoch-compiled path replicates the whole dataset into HBM (fine for
-    CIFAR: ~150 MB uint8 per device; every process loads the same data and
-    computes the same index matrices, so multi-host runs stay consistent by
-    construction) and has no per-step host boundary, so it cannot bracket a
-    profiler trace window around individual steps. Raising here (rather
-    than per entry point) keeps ``main.py`` and ``supervised.py`` in
-    lockstep.
+    The epoch-compiled path replicates the whole dataset into HBM and has no
+    per-step host boundary, so it cannot bracket a profiler trace window
+    around individual steps. Raising here (rather than per entry point)
+    keeps ``main.py`` and ``supervised.py`` in lockstep.
+
+    Single-host only (``conf/config.yaml`` documents this): the entry points
+    ``jax.device_put`` a host-committed dataset onto a replicated sharding,
+    which on multi-host would span non-addressable devices and fail opaquely
+    inside XLA instead of with a clear error. Implementing the multi-host
+    upload would need ``make_array_from_process_local_data`` plus identical
+    per-process index matrices — unimplemented and untested, so refuse.
     """
+    if jax.process_count() > 1:
+        raise ValueError(
+            "runtime.epoch_compile is single-host only: the dataset upload "
+            "uses jax.device_put onto a replicated sharding, which cannot "
+            "address other hosts' devices. Use the per-step path "
+            "(runtime.epoch_compile=false) on multi-host."
+        )
     if n_samples < global_batch:
         # the per-step path raises this inside EpochIterator; here it would
         # otherwise run a zero-length scan and checkpoint untrained params
@@ -420,25 +431,31 @@ def make_supervised_eval_step(model, mesh) -> Callable[..., Metrics]:
     The SPMD analogue of the reference's ``dist.barrier`` + two
     ``dist.reduce(dst=0)`` calls (``/root/reference/supervised.py:137-139``)
     — here a ``psum`` that leaves identical totals on every replica.
+
+    Takes a per-row ``valid`` float mask so a non-divisible validation set
+    can be tail-padded to the static batch shape and still evaluated in this
+    one compiled path (the reference's ``drop_last=False`` semantics,
+    ``supervised.py:219-223``): padded rows contribute zero loss/correct/
+    count. Callers pass ``valid=1`` on real rows, ``0`` on padding.
     """
 
-    def local_step(params, batch_stats, images, labels):
+    def local_step(params, batch_stats, images, labels, valid):
         x = to_float(images)
         logits = model.apply(
             {"params": params, "batch_stats": batch_stats}, x, train=False
         ).astype(jnp.float32)
         per_example = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-        sum_loss = jax.lax.psum(per_example.sum(), DATA_AXIS)
+        sum_loss = jax.lax.psum((per_example * valid).sum(), DATA_AXIS)
         correct = jax.lax.psum(
-            jnp.sum(jnp.argmax(logits, -1) == labels).astype(jnp.float32), DATA_AXIS
+            jnp.sum((jnp.argmax(logits, -1) == labels) * valid), DATA_AXIS
         )
-        count = jax.lax.psum(jnp.asarray(labels.shape[0], jnp.float32), DATA_AXIS)
+        count = jax.lax.psum(valid.sum(), DATA_AXIS)
         return {"sum_loss": sum_loss, "correct": correct, "count": count}
 
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(_REP, _REP, _BATCH, _BATCH),
+        in_specs=(_REP, _REP, _BATCH, _BATCH, _BATCH),
         out_specs=_REP,
         check_vma=False,
     )
@@ -453,9 +470,20 @@ def make_encode_step(
     ``use_full_encoder=False`` returns encoder features h (``model.encode``,
     reference ``eval.py:47-50`` / ``model.py:116-123``); True returns
     projection-head output z.
-    """
 
-    @jax.jit
+    Explicit in/out shardings over ``mesh`` make this a true global SPMD
+    program: the batch stays sharded over the data axis end to end (the
+    multi-host input side is ``mesh.put_global_batch``, the output side
+    ``_fetch``'s process_allgather), variables are replicated.
+    """
+    rep = NamedSharding(mesh, _REP)
+    batched = NamedSharding(mesh, _BATCH)
+
+    @partial(
+        jax.jit,
+        in_shardings=(rep, rep, batched),
+        out_shardings=batched,
+    )
     def encode(params, batch_stats, images):
         x = to_float(images)
         variables = {"params": params, "batch_stats": batch_stats}
@@ -476,9 +504,16 @@ def make_augmented_encode_step(
 
     Reference: ``convert_vectors_for_contrastive`` feeds view0 of the 2-view
     transform through the frozen model (``save_features.py:50-77,166-179``).
+    Sharded over ``mesh`` like :func:`make_encode_step`.
     """
+    rep = NamedSharding(mesh, _REP)
+    batched = NamedSharding(mesh, _BATCH)
 
-    @jax.jit
+    @partial(
+        jax.jit,
+        in_shardings=(rep, rep, batched, rep),
+        out_shardings=batched,
+    )
     def encode(params, batch_stats, images, rng):
         keys = jax.random.split(rng, images.shape[0])
         aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))

@@ -30,6 +30,8 @@ from simclr_tpu.models.contrastive import ContrastiveModel
 from simclr_tpu.parallel.mesh import (
     batch_sharding,
     mesh_from_config,
+    process_local_rows,
+    put_global_batch,
     validate_per_device_batch,
 )
 from simclr_tpu.parallel.steps import make_augmented_encode_step
@@ -62,12 +64,14 @@ def augmented_features(
         if pad
         else images
     )
+    local = process_local_rows(batch)  # multi-host: upload only this
+    # process's row block of each chunk (see eval.extract_features)
     mean = None
     out: dict[int, np.ndarray] = {}
     for t in range(1, num_passes + 1):
         feats = []
         for i in range(steps):
-            chunk = jax.device_put(padded[i * batch : (i + 1) * batch], sharding)
+            chunk = put_global_batch(padded[i * batch : (i + 1) * batch][local], sharding)
             rng = jax.random.fold_in(jax.random.key(seed), t * steps + i)
             feats.append(
                 _fetch(encode(variables["params"], variables["batch_stats"], chunk, rng))

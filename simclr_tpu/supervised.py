@@ -13,6 +13,7 @@ validation loss or accuracy, deleting the previous best
 
 from __future__ import annotations
 
+import math
 import os
 import sys
 import time
@@ -20,18 +21,19 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from simclr_tpu.config import Config, check_supervised_conf, load_config, resolve_save_dir
 from simclr_tpu.data.cifar import NUM_CLASSES, load_dataset
 from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import SupervisedModel
-from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+from simclr_tpu.ops.lars import get_weight_decay_mask, lars
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     mesh_from_config,
+    process_local_rows,
+    put_global_batch,
     replicated_sharding,
     validate_per_device_batch,
 )
@@ -44,7 +46,7 @@ from simclr_tpu.parallel.steps import (
 from simclr_tpu.parallel.train_state import create_train_state, param_count
 from simclr_tpu.utils.checkpoint import checkpoint_name, delete_checkpoint, save_checkpoint
 from simclr_tpu.utils.logging import get_logger, is_logging_host
-from simclr_tpu.utils.profiling import StepTraceWindow
+from simclr_tpu.utils.profiling import StepTimer, StepTraceWindow
 from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
 
 logger = get_logger()
@@ -96,7 +98,10 @@ def run_supervised(cfg: Config) -> dict:
         schedule,
         trust_coefficient=0.001,
         weight_decay=float(cfg.experiment.decay),
-        weight_decay_mask=simclr_weight_decay_mask,
+        weight_decay_mask=get_weight_decay_mask(
+            str(cfg.select("optimizer.weight_decay_mask", "structural")),
+            str(cfg.experiment.base_cnn),
+        ),
         momentum=float(cfg.parameter.momentum),
     )
 
@@ -138,9 +143,21 @@ def run_supervised(cfg: Config) -> dict:
             gather_threads=int(cfg.parameter.num_workers),
         )
     # validation: no shuffle, keep every sample (reference drop_last=False,
-    # supervised.py:219-223). Tail remainder is evaluated in a host-side pass.
-    val_steps = len(val_ds) // global_batch
-    val_tail = len(val_ds) - val_steps * global_batch
+    # supervised.py:219-223). The tail remainder is zero-padded to the static
+    # batch shape and masked out inside the one jitted eval step — a single
+    # code path, same dtype/sharding as full batches, multi-host safe.
+    val_steps = math.ceil(len(val_ds) / global_batch)
+    val_pad = val_steps * global_batch - len(val_ds)
+    val_images = val_ds.images
+    val_labels = val_ds.labels
+    val_valid = np.ones(len(val_ds), np.float32)
+    if val_pad:
+        val_images = np.concatenate(
+            [val_images, np.zeros((val_pad, *val_images.shape[1:]), val_images.dtype)]
+        )
+        val_labels = np.concatenate([val_labels, np.zeros(val_pad, val_labels.dtype)])
+        val_valid = np.concatenate([val_valid, np.zeros(val_pad, np.float32)])
+    val_local = process_local_rows(global_batch)
 
     save_dir = resolve_save_dir(cfg)
     metric = str(cfg.parameter.metric)
@@ -159,6 +176,13 @@ def run_supervised(cfg: Config) -> dict:
     history = []
     t_start = time.time()
     cur_step = 0  # host-side mirror of state.step: avoids per-step device sync
+    # steady-state training throughput like main.py's: validation sweeps and
+    # checkpoint I/O are pause()d out of the timed window. In epoch_compile
+    # mode one tick covers a whole epoch of steps.
+    timer = StepTimer(
+        global_batch * (steps_per_epoch if epoch_compile else 1),
+        warmup=1 if epoch_compile else 3,
+    )
     tracer = StepTraceWindow(
         cfg.select("experiment.profile_dir"),
         start=2,
@@ -177,6 +201,7 @@ def run_supervised(cfg: Config) -> dict:
                 state, images_all, labels_all, idx_e, base_key, cur_step
             )
             train_metrics = {k: v[-1] for k, v in epoch_metrics.items()}
+            timer.tick(epoch_metrics["loss"])
             cur_step += steps_per_epoch
         else:
             for batch in prefetch(train_iter.batches(epoch)):
@@ -185,46 +210,37 @@ def run_supervised(cfg: Config) -> dict:
                 state, train_metrics = train_step(
                     state, batch["image"], batch["label"], step_rng
                 )
+                timer.tick(train_metrics["loss"])
                 cur_step += 1
 
-        # distributed validation (reference supervised.py:30-58,135-139)
+        # distributed validation (reference supervised.py:30-58,135-139);
+        # tail batch rides the same jitted step via the valid mask
+        timer.pause(train_metrics["loss"])  # keep eval out of the imgs/sec window
         sum_loss, correct, count = 0.0, 0.0, 0.0
         for start in range(0, val_steps * global_batch, global_batch):
+            sl = slice(start, start + global_batch)
             totals = eval_step(
                 state.params,
                 state.batch_stats,
-                jax.device_put(val_ds.images[start : start + global_batch], data_shard),
-                jax.device_put(val_ds.labels[start : start + global_batch], data_shard),
+                put_global_batch(val_images[sl][val_local], data_shard),
+                put_global_batch(val_labels[sl][val_local], data_shard),
+                put_global_batch(val_valid[sl][val_local], data_shard),
             )
             sum_loss += float(totals["sum_loss"])
             correct += float(totals["correct"])
             count += float(totals["count"])
-        if val_tail:
-            # remainder batch doesn't tile the mesh; replicate and slice on host
-            tail_img = val_ds.images[val_steps * global_batch :]
-            tail_lbl = val_ds.labels[val_steps * global_batch :]
-            logits = model.apply(
-                {"params": state.params, "batch_stats": state.batch_stats},
-                jnp.asarray(tail_img, jnp.float32) / 255.0,
-                train=False,
-            ).astype(jnp.float32)
-            sum_loss += float(
-                optax.softmax_cross_entropy_with_integer_labels(
-                    logits, jnp.asarray(tail_lbl)
-                ).sum()
-            )
-            correct += float(np.sum(np.argmax(np.asarray(logits), -1) == tail_lbl))
-            count += float(val_tail)
 
         val_loss = sum_loss / max(count, 1.0)
         val_acc = correct / max(count, 1.0)
         history.append({"epoch": epoch, "val_loss": val_loss, "val_acc": val_acc})
         if is_logging_host():
+            imgs_per_sec = cur_step * global_batch / max(time.time() - t_start, 1e-9)
             logger.info(
                 "Epoch:%d/%d progress:%.3f train_loss:%.3f val_loss:%.4f "
-                "val_acc:%.4f lr:%.7f",
+                "val_acc:%.4f lr:%.7f imgs/sec(cum):%.0f",
                 epoch, epochs, epoch / epochs, float(train_metrics["loss"]),
                 val_loss, val_acc, float(schedule(max(cur_step - 1, 0))),
+                imgs_per_sec,
             )
 
         # best-only checkpoint policy (reference supervised.py:144-162)
@@ -242,10 +258,19 @@ def run_supervised(cfg: Config) -> dict:
                 checkpoint_name(epoch, f"supervised-{cfg.experiment.name}.pt"),
             )
             save_checkpoint(best_path, state)
+        timer.resume()
 
-    del t_start
     tracer.close(pending=train_metrics["loss"])
+    throughput = timer.summary()
+    if is_logging_host() and throughput["steps"] > 0:
+        timed_steps = throughput["steps"] * (steps_per_epoch if epoch_compile else 1)
+        logger.info(
+            "steady-state: %.0f imgs/sec (%.0f per chip) over %d steps",
+            throughput["imgs_per_sec"], throughput["imgs_per_sec_per_chip"],
+            timed_steps,
+        )
     return {
+        "imgs_per_sec_steady": throughput["imgs_per_sec"],
         "best_epoch": best_epoch,
         "best_value": best_value,
         "best_path": best_path,

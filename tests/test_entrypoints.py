@@ -16,6 +16,8 @@ from simclr_tpu.main import main as pretrain_main
 from simclr_tpu.save_features import main as save_features_main
 from simclr_tpu.supervised import main as supervised_main
 
+pytestmark = pytest.mark.slow  # multi-minute on a 1-core host
+
 SYNTH = [
     "experiment.synthetic_data=true",
     "experiment.synthetic_size=64",
@@ -154,12 +156,15 @@ class TestSupervised:
         summary = supervised_main(
             SYNTH
             + [
+                # 48 is NOT divisible by the global batch of 32: the val
+                # tail (16 rows) must ride the masked jitted eval path
+                "experiment.synthetic_size=48",
                 "parameter.epochs=1",
                 "parameter.warmup_epochs=0",
                 f"experiment.save_dir={save_dir}",
             ]
         )
-        assert summary["steps"] == 2
+        assert summary["steps"] == 1  # train drop_last: 48 // 32
         assert summary["best_epoch"] == 1
         assert os.path.isdir(summary["best_path"])
         assert 0.0 <= summary["history"][0]["val_acc"] <= 1.0

@@ -11,6 +11,7 @@ roundings).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from simclr_tpu.data.cifar import synthetic_dataset
 from simclr_tpu.data.pipeline import epoch_index_matrix, epoch_permutation
@@ -51,6 +52,7 @@ def _init_state(model, tx, mesh):
     return jax.device_put(state, replicated_sharding(mesh))
 
 
+@pytest.mark.slow
 def test_epoch_scan_matches_per_step_loop():
     mesh, model, tx, ds = _setup()
     base_key = jax.random.key(11)
@@ -90,6 +92,7 @@ def test_epoch_scan_matches_per_step_loop():
     np.testing.assert_allclose(pa, pb, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_supervised_epoch_compile_entrypoint(tmp_path):
     from simclr_tpu.supervised import run_supervised
     from simclr_tpu.config import load_config
@@ -113,6 +116,7 @@ def test_supervised_epoch_compile_entrypoint(tmp_path):
     assert len(kept) == 1
 
 
+@pytest.mark.slow
 def test_epoch_compile_entrypoint(tmp_path):
     from simclr_tpu.main import run_pretrain
     from simclr_tpu.config import load_config
@@ -136,12 +140,29 @@ def test_epoch_compile_entrypoint(tmp_path):
     assert (tmp_path / "epoch=2-cifar10").exists()
 
 
-def test_epoch_compile_preconditions():
+def test_epoch_compile_preconditions(monkeypatch, caplog):
+    import logging
+
     import pytest
 
+    from simclr_tpu.parallel import steps
     from simclr_tpu.parallel.steps import check_epoch_compile_preconditions
 
     # single-process, dataset >= one global batch: fine
     check_epoch_compile_preconditions(64, 32)
     with pytest.raises(ValueError, match="smaller than global batch"):
         check_epoch_compile_preconditions(16, 32)
+
+    # profile_dir is incompatible with the scan path: warns, does not raise
+    from simclr_tpu.utils.logging import get_logger
+
+    monkeypatch.setattr(get_logger(), "propagate", True)  # let caplog see it
+    with caplog.at_level(logging.WARNING):
+        check_epoch_compile_preconditions(64, 32, profile_dir="/tmp/prof")
+    assert any("profile_dir is ignored" in r.message for r in caplog.records)
+
+    # multi-host: the replicated dataset upload cannot address other hosts'
+    # devices — must refuse loudly (conf/config.yaml "Single-host only")
+    monkeypatch.setattr(steps.jax, "process_count", lambda: 2)
+    with pytest.raises(ValueError, match="single-host only"):
+        check_epoch_compile_preconditions(64, 32)

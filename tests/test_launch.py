@@ -12,6 +12,10 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute on a 1-core host
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -58,6 +62,54 @@ def test_two_process_pretrain_end_to_end(tmp_path):
     assert (save_dir / "epoch=1-cifar10").exists(), result.stderr[-2000:]
     # exactly one process logs (the reference's rank-0-only logging)
     assert result.stderr.count("Epoch:1/1") == 1, result.stderr[-2000:]
+
+
+def test_two_process_eval_end_to_end(tmp_path):
+    """Multi-host feature extraction (VERDICT r1 #5): eval's input side must
+    assemble globally-sharded batches from per-process row blocks
+    (``put_global_batch``), not ``device_put`` arrays it can't fully address.
+    Covers extract_features + centroid probe + results JSON under 2 real
+    processes."""
+    save_dir = tmp_path / "ckpts"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "--coordinator", "127.0.0.1:13371",
+            "-m", "simclr_tpu.main",
+            "parameter.epochs=1",
+            "experiment.batches=8",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    eval_dir = tmp_path / "eval"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "--coordinator", "127.0.0.1:13372",
+            "-m", "simclr_tpu.eval",
+            "parameter.classifier=centroid",
+            "experiment.batches=8",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.target_dir={save_dir}",
+            f"experiment.save_dir={eval_dir}",
+        ]
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    results_files = list(eval_dir.rglob("results.json"))
+    assert len(results_files) == 1, result.stderr[-2000:]
+    import json
+
+    results = json.load(open(results_files[0]))
+    (ckpt_results,) = results.values()
+    assert 0.0 <= ckpt_results["val_acc"] <= 1.0
 
 
 def test_fail_fast_on_child_killed_mid_run(tmp_path):

@@ -160,7 +160,8 @@ class TestSupervisedStep:
         assert 0.0 <= float(metrics["accuracy"]) <= 1.0
         assert int(state.step) == 1
 
-        totals = eval_step(state.params, state.batch_stats, images, labels)
+        valid = jax.device_put(np.ones(16, np.float32), sharding)
+        totals = eval_step(state.params, state.batch_stats, images, labels, valid)
         assert float(totals["count"]) == 16.0
         assert 0.0 <= float(totals["correct"]) <= 16.0
         assert np.isfinite(float(totals["sum_loss"]))
@@ -180,6 +181,7 @@ class TestSupervisedStep:
             state.batch_stats,
             jax.device_put(images_np, sharding),
             jax.device_put(labels_np, sharding),
+            jax.device_put(np.ones(16, np.float32), sharding),
         )
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
@@ -187,6 +189,42 @@ class TestSupervisedStep:
             train=False,
         )
         expected_correct = float(np.sum(np.argmax(np.asarray(logits), -1) == labels_np))
+        assert float(totals["correct"]) == expected_correct
+
+    def test_eval_tail_mask_ignores_padding(self):
+        """A non-divisible validation set, zero-padded to the static batch
+        shape with valid=0 on the padding, must yield identical totals to the
+        real rows alone — the single-code-path replacement for the old eager
+        host-side tail pass (VERDICT r1 #6)."""
+        mesh = create_mesh()
+        model = TinySupervised(bn_cross_replica_axis=DATA_AXIS)
+        tx = lars(0.1)
+        state = _make_state(model, tx)
+        eval_step = make_supervised_eval_step(model, mesh)
+        sharding = batch_sharding(mesh)
+
+        n_real, batch = 13, 16  # 13 real rows padded up to one global batch
+        images_np = _images(batch)
+        labels_np = np.arange(batch, dtype=np.int32) % 10
+        images_np[n_real:] = 0  # padding rows: arbitrary content
+        valid = np.zeros(batch, np.float32)
+        valid[:n_real] = 1.0
+        totals = eval_step(
+            state.params,
+            state.batch_stats,
+            jax.device_put(images_np, sharding),
+            jax.device_put(labels_np, sharding),
+            jax.device_put(valid, sharding),
+        )
+        assert float(totals["count"]) == float(n_real)
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images_np[:n_real].astype(np.float32) / 255.0,
+            train=False,
+        )
+        expected_correct = float(
+            np.sum(np.argmax(np.asarray(logits), -1) == labels_np[:n_real])
+        )
         assert float(totals["correct"]) == expected_correct
 
 

@@ -122,8 +122,27 @@ def _run_measurement(backend: str, timeout_s: int):
 
 
 def worker(backend: str) -> None:
-    """The actual measurement (runs in a subprocess; may crash/hang freely)."""
+    """The actual measurement (runs in a subprocess; may crash/hang freely).
+
+    ``backend`` is the parent's intent; the actual backend comes from the
+    environment the parent set (JAX_PLATFORMS) — assert they agree so a
+    mis-invoked worker fails loudly instead of measuring the wrong device.
+    ``ensure_platform`` must run BEFORE the first backend touch: this
+    environment's sitecustomize pins the TPU platform over the env var, and
+    asking the default backend with that pin in place blocks on the (possibly
+    hung) device tunnel even when the caller wanted cpu.
+    """
+    from simclr_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+
     import jax
+
+    if backend == "cpu":
+        assert jax.default_backend() == "cpu", (
+            f"worker asked for cpu but got {jax.default_backend()}; "
+            "invoke via the orchestrator (it sets JAX_PLATFORMS)"
+        )
     import jax.numpy as jnp
     import numpy as np
 

@@ -1,0 +1,262 @@
+"""Training-DYNAMICS parity against a reference-recipe torch loop.
+
+The reference's only correctness machinery is its reproducible accuracy
+tables; the strongest parity evidence available without CIFAR archives is
+step-for-step equivalence of the *training dynamics*: same init (via the
+torch-import shim), same pre-augmented batches, reference recipe on both
+sides — NT-Xent with local negatives (``/root/reference/loss.py:25-65``),
+Apex-LARC(clip=False)-wrapped SGD momentum (``main.py:85-94``), masked weight
+decay (``main.py:18-36``), per-step warmup + cosine LR (``lr_utils.py:18-26``,
+``main.py:96-120``) — asserting our jitted step tracks torch's losses and
+parameters within float32 tolerance over several steps.
+
+The torch side below is an independent transcription of the reference recipe
+driving a stock torch model (the same ``_TorchContrastive`` used for the
+checkpoint-import tests); no reference code is imported.
+
+Also quantifies the documented weight-decay-mask deviation (ops/lars.py): the
+reference's ("bias", "bn") substring skip misses torchvision's
+``downsample.1`` BN scales and the head BN scale, which therefore DO get
+decayed there. ``reference_weight_decay_mask`` replicates that rule exactly
+(used here for the tight parity assertion); the structural-vs-reference drift
+is measured and bounded. Measured numbers are recorded in PARITY.md.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from simclr_tpu.models.contrastive import ContrastiveModel  # noqa: E402
+from simclr_tpu.ops.lars import (  # noqa: E402
+    lars,
+    reference_weight_decay_mask,
+    simclr_weight_decay_mask,
+)
+from simclr_tpu.ops.ntxent import ntxent_loss  # noqa: E402
+from simclr_tpu.utils.schedule import warmup_cosine_schedule  # noqa: E402
+from simclr_tpu.utils.torch_import import import_contrastive_state_dict  # noqa: E402
+
+from tests.test_torch_import import _TorchContrastive  # noqa: E402
+
+pytestmark = pytest.mark.slow  # two full training loops on a 1-core host
+
+BATCH = 32
+STEPS = 8
+WARMUP = 3
+LR0 = 1.0 * BATCH / 256.0  # reference linear scaling, lr_utils.py:11-15
+DECAY = 1e-4
+TEMPERATURE = 0.5
+MOMENTUM = 0.9
+TRUST = 0.001
+EPS = 1e-8
+
+
+# ---------------------------------------------------------------------------
+# Torch side: independent transcription of the reference recipe
+# ---------------------------------------------------------------------------
+
+def torch_ntxent(z0, z1, t):
+    """Reference NT-Xent math (loss.py:25-65): masked sim blocks, per-view
+    CE against diagonal targets, mean = sum / 2N."""
+    z0 = F.normalize(z0, dim=1)
+    z1 = F.normalize(z1, dim=1)
+    n = z0.shape[0]
+    targets = torch.arange(n)
+    mask = ~torch.eye(n, dtype=torch.bool)
+    sim00 = (z0 @ z0.T / t)[mask].reshape(n, n - 1)
+    sim11 = (z1 @ z1.T / t)[mask].reshape(n, n - 1)
+    sim01 = z0 @ z1.T / t
+    l0 = F.cross_entropy(torch.cat([sim01, sim00], dim=1), targets, reduction="sum")
+    l1 = F.cross_entropy(torch.cat([sim01.T, sim11], dim=1), targets, reduction="sum")
+    return (l0 + l1) / (2 * n)
+
+
+def reference_lr(i):
+    """LR used at update index i: <= warmup boundary, then the torch
+    CosineAnnealingLR trajectory (main.py:96-120, SURVEY §2.5.12)."""
+    if WARMUP > 0 and i <= WARMUP:
+        return i / WARMUP * LR0
+    t_max = STEPS - WARMUP
+    t = min(max(i - WARMUP - 1, 0), t_max)
+    return 0.5 * LR0 * (1.0 + math.cos(math.pi * t / t_max))
+
+
+def run_torch_loop(model, views):
+    """Reference train loop: two forwards, NT-Xent, LARC(clip=False)+SGD
+    momentum with the ("bias","bn") substring weight-decay skip."""
+    decay_flag = {
+        name: not any(s in name for s in ("bias", "bn"))
+        for name, _ in model.named_parameters()
+    }
+    bufs = {
+        name: torch.zeros_like(p) for name, p in model.named_parameters()
+    }
+    losses = []
+    model.train()
+    for i, (v0, v1) in enumerate(views):
+        lr = reference_lr(i)
+        model.zero_grad()
+        loss = torch_ntxent(model(v0), model(v1), TEMPERATURE)
+        loss.backward()
+        with torch.no_grad():
+            for name, p in model.named_parameters():
+                g = p.grad
+                wd = DECAY if decay_flag[name] else 0.0
+                p_norm = torch.norm(p)
+                g_norm = torch.norm(g)
+                # Apex LARC step(): decay+scale only when both norms nonzero
+                if p_norm != 0 and g_norm != 0:
+                    adaptive = TRUST * p_norm / (g_norm + wd * p_norm + EPS)
+                    g = (g + wd * p) * adaptive
+                buf = bufs[name]
+                buf.mul_(MOMENTUM).add_(g)  # torch SGD: buf = m*buf + g
+                p.add_(buf, alpha=-lr)
+        losses.append(float(loss.detach()))
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# JAX side: this framework's building blocks, single-device
+# ---------------------------------------------------------------------------
+
+def run_jax_loop(variables, views_np, mask_fn):
+    model = ContrastiveModel(base_cnn="resnet18", d=128, dtype=jnp.float32)
+    params = jax.tree.map(jnp.asarray, variables["params"])
+    stats = jax.tree.map(jnp.asarray, variables["batch_stats"])
+    schedule = warmup_cosine_schedule(LR0, STEPS, WARMUP)
+    tx = lars(
+        schedule,
+        trust_coefficient=TRUST,
+        weight_decay=DECAY,
+        weight_decay_mask=mask_fn,
+        momentum=MOMENTUM,
+        eps=EPS,
+    )
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, stats, opt_state, v0, v1):
+        def loss_fn(p):
+            # two sequential forwards, reference main.py:112-113 semantics
+            z0, mut = model.apply(
+                {"params": p, "batch_stats": stats}, v0, train=True,
+                mutable=["batch_stats"],
+            )
+            z1, mut = model.apply(
+                {"params": p, "batch_stats": mut["batch_stats"]}, v1, train=True,
+                mutable=["batch_stats"],
+            )
+            return ntxent_loss(z0, z1, TEMPERATURE), mut["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, new_opt, loss
+
+    losses = []
+    for v0, v1 in views_np:
+        params, stats, opt_state, loss = step(
+            params, stats, opt_state, jnp.asarray(v0), jnp.asarray(v1)
+        )
+        losses.append(float(loss))
+    return losses, params
+
+
+# ---------------------------------------------------------------------------
+# Shared fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def torch_init_and_views():
+    torch.manual_seed(3)
+    model = _TorchContrastive()
+    # deep-copy: the import shim is zero-copy (numpy views of the live torch
+    # storage) and run_torch_loop mutates params in place — without the copy
+    # the second test would silently start from post-training values
+    variables = jax.tree.map(
+        lambda x: np.array(x, copy=True),
+        import_contrastive_state_dict(model.state_dict()),
+    )
+    rng = np.random.default_rng(17)
+    views_np = [
+        (
+            rng.random((BATCH, 32, 32, 3), np.float32),  # NHWC, [0,1] like ToTensor
+            rng.random((BATCH, 32, 32, 3), np.float32),
+        )
+        for _ in range(STEPS)
+    ]
+    views_t = [
+        (
+            torch.from_numpy(v0.transpose(0, 3, 1, 2)),
+            torch.from_numpy(v1.transpose(0, 3, 1, 2)),
+        )
+        for v0, v1 in views_np
+    ]
+    return model, variables, views_np, views_t
+
+
+def _param_drift(params, torch_model):
+    """Worst per-leaf L2 distance to torch's params, allclose-style
+    (``atol + rtol * ||torch leaf||``): returns the max excess ratio
+    ``||a-b|| / (atol + rtol*||b||)`` so values < 1 pass. A pure relative
+    metric would blow up on BatchNorm biases (init 0, norms ~0.05 after a
+    few steps) where float32 accumulation noise dominates."""
+    ours = import_contrastive_state_dict(torch_model.state_dict())["params"]
+    atol, rtol = 5e-3, 5e-3
+    excess = jax.tree.map(
+        lambda a, b: float(
+            np.linalg.norm(np.asarray(a) - np.asarray(b))
+            / (atol + rtol * np.linalg.norm(np.asarray(b)))
+        ),
+        params,
+        jax.tree.map(jnp.asarray, ours),
+    )
+    return max(jax.tree.leaves(excess))
+
+
+def test_training_dynamics_match_reference_recipe(torch_init_and_views):
+    torch_model, variables, views_np, views_t = torch_init_and_views
+    # reference-exact weight-decay mask -> tight tracking
+    jax_losses, jax_params = run_jax_loop(
+        variables, views_np, reference_weight_decay_mask
+    )
+    torch_losses = run_torch_loop(torch_model, views_t)
+
+    # losses agree step by step (float32, two frameworks, 18-layer net;
+    # measured max relative difference ~3e-5 over 8 steps — see PARITY.md)
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=5e-4)
+
+    # parameters still agree after the full loop (measured worst leaf-L2
+    # difference 2.4e-3 absolute, concentrated in BN biases)
+    drift = _param_drift(jax_params, torch_model)
+    assert drift < 1.0, f"param drift beyond atol/rtol=5e-3 envelope: {drift}"
+
+
+def test_weight_decay_mask_deviation_is_bounded(torch_init_and_views):
+    """The structural mask (our default) deviates from the reference's
+    substring rule only on the 3 downsample BN scales + head BN scale; over a
+    short loop the induced param divergence must be tiny (and measurably
+    nonzero — this is a real, documented deviation, not a no-op)."""
+    _, variables, views_np, _ = torch_init_and_views
+    _, params_ref = run_jax_loop(variables, views_np, reference_weight_decay_mask)
+    _, params_struct = run_jax_loop(variables, views_np, simclr_weight_decay_mask)
+
+    rel = jax.tree.map(
+        lambda a, b: float(
+            np.linalg.norm(np.asarray(a) - np.asarray(b))
+            / (np.linalg.norm(np.asarray(b)) + 1e-12)
+        ),
+        params_struct,
+        params_ref,
+    )
+    worst = max(jax.tree.leaves(rel))
+    # measured: 9.0e-4 worst-leaf relative divergence after 8 steps (PARITY.md)
+    assert worst < 5e-3, f"mask deviation unexpectedly large: {worst}"
+    assert worst > 0.0, "masks produced identical trajectories — deviation gone?"

@@ -239,17 +239,27 @@ def adjust_hue(image: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
 _JITTER_PERMS = np.array(list(itertools.permutations(range(4))), dtype=np.int32)
 
 
-def color_jitter(
-    key: jax.Array, image: jnp.ndarray, strength: float = 0.5
-) -> jnp.ndarray:
-    """torchvision ColorJitter(0.8s, 0.8s, 0.8s, 0.2s) with random op order."""
+def jitter_params(key: jax.Array, strength: float = 0.5):
+    """Sample ColorJitter(0.8s, 0.8s, 0.8s, 0.2s) parameters: the three
+    blend factors U(max(0,1-r), 1+r), the hue shift U(-h, h), and the op
+    permutation index (uniform over all 24 orders). Factored out of
+    :func:`color_jitter` so distribution tests measure the SAME sampler the
+    pipeline runs (tests/test_augment_distribution.py)."""
     b, c, s, h = 0.8 * strength, 0.8 * strength, 0.8 * strength, 0.2 * strength
     k_b, k_c, k_s, k_h, k_perm = jax.random.split(key, 5)
-
     f_b = jax.random.uniform(k_b, minval=max(0.0, 1.0 - b), maxval=1.0 + b)
     f_c = jax.random.uniform(k_c, minval=max(0.0, 1.0 - c), maxval=1.0 + c)
     f_s = jax.random.uniform(k_s, minval=max(0.0, 1.0 - s), maxval=1.0 + s)
     f_h = jax.random.uniform(k_h, minval=-h, maxval=h)
+    perm_idx = jax.random.randint(k_perm, (), 0, _JITTER_PERMS.shape[0])
+    return f_b, f_c, f_s, f_h, perm_idx
+
+
+def color_jitter(
+    key: jax.Array, image: jnp.ndarray, strength: float = 0.5
+) -> jnp.ndarray:
+    """torchvision ColorJitter(0.8s, 0.8s, 0.8s, 0.2s) with random op order."""
+    f_b, f_c, f_s, f_h, perm_idx = jitter_params(key, strength)
 
     ops = [
         lambda img: adjust_brightness(img, f_b),
@@ -257,9 +267,7 @@ def color_jitter(
         lambda img: adjust_saturation(img, f_s),
         lambda img: adjust_hue(img, f_h),
     ]
-    perm = jnp.asarray(_JITTER_PERMS)[
-        jax.random.randint(k_perm, (), 0, _JITTER_PERMS.shape[0])
-    ]
+    perm = jnp.asarray(_JITTER_PERMS)[perm_idx]
     for slot in range(4):
         image = lax.switch(perm[slot], ops, image)
     return image
@@ -279,6 +287,19 @@ def random_hflip(key: jax.Array, image: jnp.ndarray, p: float = 0.5) -> jnp.ndar
 # Full pipelines
 # ---------------------------------------------------------------------------
 
+# reference pipeline probabilities (dataset.py:27-35): RandomApply(jitter)
+# p=0.8, RandomGrayscale 0.2, RandomHorizontalFlip 0.5
+_JITTER_APPLY_P = 0.8
+_GRAYSCALE_P = 0.2
+_HFLIP_P = 0.5
+
+
+def _view_keys(key: jax.Array):
+    """The one per-view key split (crop, flip, jitter-gate, jitter, gray) —
+    shared with tests that reconstruct individual pipeline branches."""
+    return jax.random.split(key, 5)
+
+
 def simclr_augment_single(
     key: jax.Array,
     image: jnp.ndarray,
@@ -287,12 +308,12 @@ def simclr_augment_single(
 ) -> jnp.ndarray:
     """One stochastic SimCLR view of one image (HWC uint8 or float [0,1])."""
     image = to_float(image)
-    k_crop, k_flip, k_apply, k_jitter, k_gray = jax.random.split(key, 5)
+    k_crop, k_flip, k_apply, k_jitter, k_gray = _view_keys(key)
     image = random_resized_crop(k_crop, image, out_size=out_size)
-    image = random_hflip(k_flip, image)
+    image = random_hflip(k_flip, image, p=_HFLIP_P)
     jittered = color_jitter(k_jitter, image, strength=strength)
-    image = jnp.where(jax.random.uniform(k_apply) < 0.8, jittered, image)
-    image = random_grayscale(k_gray, image, p=0.2)
+    image = jnp.where(jax.random.uniform(k_apply) < _JITTER_APPLY_P, jittered, image)
+    image = random_grayscale(k_gray, image, p=_GRAYSCALE_P)
     return image
 
 

@@ -67,7 +67,14 @@ def load_model_variables(ckpt_path: str) -> dict:
     whole train state; eval consumes only the model variables.
     """
     raw = restore_checkpoint(ckpt_path, None)
-    return {"params": raw["params"], "batch_stats": raw.get("batch_stats", {})}
+    # materialize to host numpy: orbax restores arrays WITH their saved
+    # shardings, and a checkpoint written on a different mesh layout (e.g. a
+    # tensor-parallel (data, model) run) would otherwise be rejected by this
+    # process's jit shardings
+    return jax.tree.map(
+        np.asarray,
+        {"params": raw["params"], "batch_stats": raw.get("batch_stats", {})},
+    )
 
 
 def _fetch(x: jax.Array) -> np.ndarray:

@@ -38,6 +38,7 @@ from simclr_tpu.models.contrastive import ContrastiveModel
 from simclr_tpu.ops.lars import get_weight_decay_mask, lars
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
+    MODEL_AXIS,
     batch_sharding,
     mesh_from_config,
     replicated_sharding,
@@ -136,7 +137,16 @@ def run_pretrain(cfg: Config) -> dict:
     state = create_train_state(
         model, tx, jax.random.key(seed), jnp.zeros((2, 32, 32, 3), jnp.float32)
     )
-    state = jax.device_put(state, replicated_sharding(mesh))
+    n_model = mesh.shape[MODEL_AXIS]
+    if n_model > 1:
+        # tensor-parallel layout from the start: head leaves sharded over the
+        # model axis, everything else replicated (parallel/tp.py); also the
+        # restore template, so resume keeps the layout
+        from simclr_tpu.parallel.tp import tp_state_shardings
+
+        state = jax.device_put(state, tp_state_shardings(mesh, state))
+    else:
+        state = jax.device_put(state, replicated_sharding(mesh))
 
     save_dir = resolve_save_dir(cfg)
     start_epoch = 1
@@ -157,7 +167,33 @@ def run_pretrain(cfg: Config) -> dict:
     )
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     data_shard = batch_sharding(mesh)
-    if epoch_compile:
+    if n_model > 1:
+        # tensor-parallel projection head over the model axis (parallel/tp.py)
+        from simclr_tpu.parallel.tp import make_pretrain_step_tp
+
+        unsupported = {
+            "runtime.epoch_compile": epoch_compile,
+            "loss.fused": step_kwargs["fused"],
+            "model.remat": step_kwargs["remat"],
+            "loss.negatives != global": step_kwargs["negatives"] != "global",
+            "model.forward_mode != two_pass": step_kwargs["forward_mode"] != "two_pass",
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            raise ValueError(
+                f"mesh.model={n_model} (tensor parallelism) does not combine "
+                f"with: {', '.join(bad)}"
+            )
+        step_fn = make_pretrain_step_tp(
+            model, tx, mesh,
+            temperature=step_kwargs["temperature"],
+            strength=step_kwargs["strength"],
+        )
+        iterator = EpochIterator(
+            dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
+            gather_threads=int(cfg.parameter.num_workers),
+        )
+    elif epoch_compile:
         check_epoch_compile_preconditions(
             len(dataset), global_batch, cfg.select("experiment.profile_dir")
         )

@@ -33,6 +33,11 @@ class ContrastiveModel(nn.Module):
     cifar_stem: bool = True
     dtype: Dtype = jnp.bfloat16
     bn_cross_replica_axis: str | None = None
+    # tensor parallelism of the projection head (parallel/tp.py): the LOCAL
+    # per-shard hidden width and the mesh axis the head is sharded over.
+    # Defaults give the global (unsharded) view used for init/checkpoints.
+    head_hidden: int | None = None
+    head_tp_axis: str | None = None
 
     def setup(self):
         self.f = ResNetEncoder(
@@ -42,7 +47,11 @@ class ContrastiveModel(nn.Module):
             bn_cross_replica_axis=self.bn_cross_replica_axis,
         )
         self.g = ProjectionHead(
-            d=self.d, dtype=self.dtype, axis_name=self.bn_cross_replica_axis
+            d=self.d,
+            dtype=self.dtype,
+            axis_name=self.bn_cross_replica_axis,
+            hidden=self.head_hidden,
+            tp_axis=self.head_tp_axis,
         )
 
     def encode(self, x, train: bool = True):

@@ -19,22 +19,85 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 Dtype = Any
 
 
+def _tp_boundary_in(axis_name: str):
+    """Megatron's f operator: identity forward, all-reduce backward.
+
+    Applied where the replicated activation enters the tensor-parallel
+    region: each shard's backward produces only its hidden-slice's partial
+    ``dL/dh``; the psum on the cotangent completes the sum. A plain forward
+    ``psum`` cannot be used for this because its transpose under shard_map
+    is ``psum`` again, which would scale replicated cotangents by the axis
+    size (pinned by tests/test_tp.py)."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (jax.lax.psum(ct, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _tp_boundary_out(axis_name: str):
+    """Megatron's g operator: all-reduce forward, identity backward.
+
+    Applied where the partial row-parallel results leave the
+    tensor-parallel region: the forward psum completes the contraction; the
+    backward must hand each shard the plain replicated cotangent (psum's
+    own transpose would multiply it by the axis size)."""
+
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis_name)
+
+    def fwd(x):
+        return jax.lax.psum(x, axis_name), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
 class ProjectionHead(nn.Module):
-    """SimCLR non-linear projection g: h -> z."""
+    """SimCLR non-linear projection g: h -> z.
+
+    Tensor parallelism (Megatron MLP pattern, the ``model`` mesh axis):
+    ``linear1`` is column-parallel (output channels sharded), ``bn1``/relu
+    act on local channels, ``linear2`` is row-parallel (input channels
+    sharded) with the f/g boundary operators handling the collectives in
+    forward AND backward. Used from inside ``shard_map`` with the LOCAL
+    view: set ``hidden`` to the per-shard width (global // tp) and
+    ``tp_axis`` to the mesh axis. Init/checkpointing always use the GLOBAL
+    view (defaults); the global (512, 512) kernel sharded
+    ``P(None, 'model')`` presents each shard the (512, 512//tp) local
+    kernel this module then expects (``parallel/tp.py``).
+    """
 
     d: int = 128
     axis_name: str | None = None
     dtype: Dtype = jnp.bfloat16
+    hidden: int | None = None  # per-shard hidden width; None = input width
+    tp_axis: str | None = None
 
     @nn.compact
     def __call__(self, h, train: bool = True):
-        hidden = h.shape[-1]
+        hidden = self.hidden or h.shape[-1]
+        if self.tp_axis is not None:
+            h = _tp_boundary_in(self.tp_axis)(h)
         y = nn.Dense(hidden, dtype=self.dtype, param_dtype=jnp.float32, name="linear1")(h)
         y = nn.BatchNorm(
             use_running_average=not train,
@@ -50,6 +113,10 @@ class ProjectionHead(nn.Module):
             self.d, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
             name="linear2",
         )(y)
+        if self.tp_axis is not None:
+            # row-parallel contraction: each shard holds a partial sum over
+            # its slice of the hidden dim; g operator completes it
+            y = _tp_boundary_out(self.tp_axis)(y)
         return y.astype(jnp.float32)
 
 

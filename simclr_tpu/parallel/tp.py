@@ -1,0 +1,186 @@
+"""Tensor parallelism over the ``model`` mesh axis (projection head).
+
+Round 1 reserved the 2-D ``(data, model)`` mesh with ``model`` unused
+(SURVEY §2.3: "design mesh so a `model` axis can be added later"). This
+module makes that axis real: the SimCLR projection head runs Megatron-style
+tensor-parallel — ``linear1`` column-parallel, ``bn1``/relu on local
+channels, ``linear2`` row-parallel with a ``psum`` over the model axis
+completing the contraction (``models/heads.py:ProjectionHead``).
+
+Design (shard_map + GSPMD hybrid):
+
+  * **Global view for state.** Params/optimizer/checkpoints always hold the
+    full (global) arrays, laid out with :func:`tp_state_shardings` — the head
+    leaves sharded over ``model`` (``linear1.kernel P(None,'model')``,
+    ``bn1.* P('model')``, ``linear2.kernel P('model',None)``), everything
+    else replicated. Checkpoint/resume and the torch-import shim are
+    untouched.
+  * **Local view for compute.** Inside ``shard_map`` each shard sees its
+    slice; the forward runs a local-view model (``head_hidden = hidden//tp``,
+    ``head_tp_axis=MODEL_AXIS``) so Flax's parameter shape checks match the
+    slices.
+  * **Backward collectives via f/g boundary operators.** Under
+    ``check_vma=False`` a raw forward ``psum`` transposes to ``psum``, which
+    scales replicated cotangents by the axis size. The head therefore wraps
+    its TP region in Megatron's f/g pair (``models/heads.py``): identity-
+    forward/psum-backward at the input (completing the partial ``dL/dh``),
+    psum-forward/identity-backward at the output. Gradients then leave the
+    shard_map already correct — no per-leaf fixups here.
+  * **Optimizer at the jit level.** ``tx.update`` runs OUTSIDE shard_map on
+    the globally-sharded pytrees, so LARS trust-ratio norms are GLOBAL param
+    and grad norms — XLA inserts the cross-shard reductions. Running the
+    update inside shard_map would silently compute per-shard norms for the
+    head and diverge from the unsharded recipe.
+
+Equivalence is tested in tests/test_tp.py: a (d, m) mesh step matches the
+(d, 1) degenerate step loss- and param-wise, and the sharded head forward
+matches the unsharded module output.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from simclr_tpu.models.resnet import feature_dim
+from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
+from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from simclr_tpu.parallel.steps import _augment_two_views
+from simclr_tpu.parallel.train_state import TrainState
+
+
+def _names(path) -> list[str]:
+    """Trailing DictKey names of a pytree path (works for params,
+    batch_stats, and optimizer-state leaves alike — optax trace state mirrors
+    the params tree under extra non-dict keys)."""
+    return [str(k.key) for k in path if isinstance(k, jax.tree_util.DictKey)]
+
+
+def _head_pspec(names: list[str]) -> P:
+    """PartitionSpec for one leaf, by its dict-path suffix."""
+    if "g" in names:
+        sub = names[names.index("g"):]
+        if len(sub) >= 2:
+            if sub[1] == "linear1" and sub[-1] == "kernel":
+                return P(None, MODEL_AXIS)  # column-parallel: out dim sharded
+            if sub[1] == "linear1" and sub[-1] == "bias":
+                return P(MODEL_AXIS)
+            if sub[1] == "bn1":  # scale/bias params and mean/var stats
+                return P(MODEL_AXIS)
+            if sub[1] == "linear2" and sub[-1] == "kernel":
+                return P(MODEL_AXIS, None)  # row-parallel: in dim sharded
+    return P()
+
+
+def tree_pspecs(tree):
+    """Pytree of PartitionSpecs: head leaves sharded over ``model``."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [_head_pspec(_names(path)) for path, _ in flat]
+    )
+
+
+def state_pspecs(state: TrainState) -> TrainState:
+    """TrainState-shaped pytree of PartitionSpecs (step replicated)."""
+    return TrainState(
+        step=P(),
+        params=tree_pspecs(state.params),
+        batch_stats=tree_pspecs(state.batch_stats),
+        opt_state=tree_pspecs(state.opt_state),
+    )
+
+
+def tp_state_shardings(mesh, state: TrainState) -> TrainState:
+    """NamedSharding tree for ``jax.device_put`` of a global-view state."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        state_pspecs(state),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _local_view(model, tp: int):
+    """The per-shard model the shard_map body applies (clone keeps every
+    other field in lockstep with the global-view model)."""
+    hidden = feature_dim(model.base_cnn)
+    if hidden % tp:
+        raise ValueError(
+            f"projection hidden width {hidden} not divisible by model axis {tp}"
+        )
+    return model.clone(head_hidden=hidden // tp, head_tp_axis=MODEL_AXIS)
+
+
+def make_pretrain_step_tp(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
+    """Contrastive train step with the projection head tensor-parallel over
+    the ``model`` mesh axis (global NT-Xent negatives over ``data``).
+
+    Same contract as :func:`simclr_tpu.parallel.steps.make_pretrain_step`:
+    ``(state, images_u8, rng) -> (state, metrics)``; ``state`` must be laid
+    out with :func:`tp_state_shardings`. With ``model=1`` this degenerates to
+    the data-parallel step (tested equivalent).
+    """
+    tp = mesh.shape[MODEL_AXIS]
+    local_model = _local_view(model, tp)
+
+    def local_fwd_bwd(params, batch_stats, images, rng):
+        # the dp step's exact augmentation recipe (steps.py): keys depend on
+        # the DATA shard index only, so model-axis replicas agree
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+        v0, v1 = _augment_two_views(rng, images, strength, out_size)
+
+        def loss_fn(p):
+            z0, mut = local_model.apply(
+                {"params": p, "batch_stats": batch_stats}, v0, train=True,
+                mutable=["batch_stats"],
+            )
+            z1, mut = local_model.apply(
+                {"params": p, "batch_stats": mut["batch_stats"]}, v1, train=True,
+                mutable=["batch_stats"],
+            )
+            loss = ntxent_loss_sharded_rows(z0, z1, DATA_AXIS, temperature)
+            return loss, mut["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = jax.lax.psum(grads, DATA_AXIS)  # same convention as steps.py
+        # No model-axis correction here: the head's f/g boundary operators
+        # (models/heads.py) own the model-axis collectives in both forward
+        # and backward, so encoder grads arrive complete and replica-
+        # identical and head-shard grads are exact local values — pinned by
+        # tests/test_tp.py::test_tp_step_matches_degenerate_model_axis.
+        return loss, grads, new_stats
+
+    def step(state: TrainState, images: jax.Array, rng: jax.Array):
+        p_specs = tree_pspecs(state.params)
+        s_specs = tree_pspecs(state.batch_stats)
+        sharded = jax.shard_map(
+            local_fwd_bwd,
+            mesh=mesh,
+            in_specs=(p_specs, s_specs, P(DATA_AXIS), P()),
+            out_specs=(P(), p_specs, s_specs),
+            check_vma=False,
+        )
+        loss, grads, new_stats = sharded(state.params, state.batch_stats, images, rng)
+        # jit-level (GSPMD) optimizer update: norms over the GLOBAL arrays
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(step, donate_argnums=(0,))

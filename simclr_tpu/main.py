@@ -41,6 +41,7 @@ from simclr_tpu.parallel.mesh import (
     MODEL_AXIS,
     batch_sharding,
     mesh_from_config,
+    put_replicated,
     replicated_sharding,
     validate_per_device_batch,
 )
@@ -199,10 +200,9 @@ def run_pretrain(cfg: Config) -> dict:
         )
         epoch_fn = make_pretrain_epoch_fn(model, tx, mesh, **step_kwargs)
         # the whole uint8 dataset lives in HBM for the run; batches are
-        # gathered on device by shuffled index inside the epoch scan
-        images_all = jax.device_put(
-            jnp.asarray(dataset.images), replicated_sharding(mesh)
-        )
+        # gathered on device by shuffled index inside the epoch scan.
+        # put_replicated is the multi-host-safe replicated upload
+        images_all = put_replicated(dataset.images, mesh)
         iterator = None
     else:
         step_fn = make_pretrain_step(model, tx, mesh, **step_kwargs)

@@ -67,20 +67,14 @@ def check_epoch_compile_preconditions(
     around individual steps. Raising here (rather than per entry point)
     keeps ``main.py`` and ``supervised.py`` in lockstep.
 
-    Single-host only (``conf/config.yaml`` documents this): the entry points
-    ``jax.device_put`` a host-committed dataset onto a replicated sharding,
-    which on multi-host would span non-addressable devices and fail opaquely
-    inside XLA instead of with a clear error. Implementing the multi-host
-    upload would need ``make_array_from_process_local_data`` plus identical
-    per-process index matrices — unimplemented and untested, so refuse.
+    Multi-host runs are supported: every process loads the same dataset and
+    derives identical index matrices from the shared seed, and the dataset
+    upload goes through ``mesh.put_replicated``
+    (``make_array_from_process_local_data``), which assembles the global
+    replicated array from per-process copies instead of ``device_put``-ing
+    onto non-addressable devices. Exercised by a real 2-process launch in
+    tests/test_launch.py.
     """
-    if jax.process_count() > 1:
-        raise ValueError(
-            "runtime.epoch_compile is single-host only: the dataset upload "
-            "uses jax.device_put onto a replicated sharding, which cannot "
-            "address other hosts' devices. Use the per-step path "
-            "(runtime.epoch_compile=false) on multi-host."
-        )
     if n_samples < global_batch:
         # the per-step path raises this inside EpochIterator; here it would
         # otherwise run a zero-length scan and checkpoint untrained params

@@ -34,6 +34,7 @@ from simclr_tpu.parallel.mesh import (
     mesh_from_config,
     process_local_rows,
     put_global_batch,
+    put_replicated,
     replicated_sharding,
     validate_per_device_batch,
 )
@@ -127,12 +128,8 @@ def run_supervised(cfg: Config) -> dict:
         epoch_fn = make_supervised_epoch_fn(
             model, tx, mesh, strength=float(cfg.experiment.strength)
         )
-        images_all = jax.device_put(
-            jnp.asarray(train_ds.images), replicated_sharding(mesh)
-        )
-        labels_all = jax.device_put(
-            jnp.asarray(train_ds.labels), replicated_sharding(mesh)
-        )
+        images_all = put_replicated(train_ds.images, mesh)
+        labels_all = put_replicated(train_ds.labels, mesh)
         train_iter = None
     else:
         train_step = make_supervised_step(

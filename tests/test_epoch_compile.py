@@ -161,8 +161,8 @@ def test_epoch_compile_preconditions(monkeypatch, caplog):
         check_epoch_compile_preconditions(64, 32, profile_dir="/tmp/prof")
     assert any("profile_dir is ignored" in r.message for r in caplog.records)
 
-    # multi-host: the replicated dataset upload cannot address other hosts'
-    # devices — must refuse loudly (conf/config.yaml "Single-host only")
+    # multi-host is supported (put_replicated upload; identical per-process
+    # index matrices): preconditions must NOT refuse on process count. The
+    # real 2-process run is tests/test_launch.py::test_two_process_epoch_compile
     monkeypatch.setattr(steps.jax, "process_count", lambda: 2)
-    with pytest.raises(ValueError, match="single-host only"):
-        check_epoch_compile_preconditions(64, 32)
+    check_epoch_compile_preconditions(64, 32)

@@ -112,6 +112,58 @@ def test_two_process_eval_end_to_end(tmp_path):
     assert 0.0 <= ckpt_results["val_acc"] <= 1.0
 
 
+def test_two_process_epoch_compile(tmp_path):
+    """runtime.epoch_compile under 2 real processes: the replicated dataset
+    upload must go through make_array_from_process_local_data
+    (mesh.put_replicated) — a plain device_put cannot address the peer's
+    devices. Both processes derive identical index matrices from the seed."""
+    save_dir = tmp_path / "ckpts"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "--coordinator", "127.0.0.1:13381",
+            "-m", "simclr_tpu.main",
+            "runtime.epoch_compile=true",
+            "parameter.epochs=1",
+            "experiment.batches=8",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert (save_dir / "epoch=1-cifar10").exists(), result.stderr[-2000:]
+    assert result.stderr.count("Epoch:1/1") == 1, result.stderr[-2000:]
+
+
+def test_two_process_supervised_epoch_compile(tmp_path):
+    """Supervised epoch_compile under 2 real processes: covers the second
+    put_replicated call site (images AND labels), the on-device epoch scan,
+    and the masked distributed validation sweep multi-process."""
+    save_dir = tmp_path / "ckpts"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "--coordinator", "127.0.0.1:13391",
+            "-m", "simclr_tpu.supervised",
+            "runtime.epoch_compile=true",
+            "parameter.epochs=1",
+            "experiment.batches=8",
+            "parameter.warmup_epochs=0",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    kept = [p for p in save_dir.iterdir() if p.name.startswith("epoch=")]
+    assert len(kept) == 1, result.stderr[-2000:]
+
+
 def test_fail_fast_on_child_killed_mid_run(tmp_path):
     """SIGKILL one child mid-training: the launcher must notice the dead
     peer (even though the survivor blocks in a collective waiting for it)

@@ -119,19 +119,15 @@ def put_global_batch(local_rows: np.ndarray, sharding: NamedSharding) -> jax.Arr
 def put_replicated(array, mesh: Mesh) -> jax.Array:
     """Fully-replicated device placement, multi-host safe.
 
-    ``jax.device_put(x, replicated_sharding(mesh))`` fails on multi-host (a
-    process cannot address other hosts' devices); with a replicated sharding
-    every process already holds the whole value, so
-    ``make_array_from_process_local_data`` assembles the global array from
-    identical per-process copies. Callers must pass the same value on every
-    process (e.g. the dataset loaded from the same source, or index matrices
-    derived from the same seed). Single-process: a plain ``device_put``.
+    For uncommitted/numpy inputs ``jax.device_put`` supports replicated
+    shardings spanning non-addressable devices, and on multi-host it runs a
+    cross-process equality check on the value — exactly the invariant our
+    callers rely on (every process passes the same dataset / index
+    matrices), so divergent per-process data fails loudly instead of
+    training silently. Exercised under 2 real processes by the
+    epoch_compile launch tests.
     """
-    sharding = replicated_sharding(mesh)
-    if jax.process_count() > 1:
-        arr = np.asarray(array)
-        return jax.make_array_from_process_local_data(sharding, arr, arr.shape)
-    return jax.device_put(array, sharding)
+    return jax.device_put(np.asarray(array), replicated_sharding(mesh))
 
 
 def process_local_rows(n_global_rows: int) -> slice:

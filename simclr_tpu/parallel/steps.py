@@ -68,12 +68,10 @@ def check_epoch_compile_preconditions(
     keeps ``main.py`` and ``supervised.py`` in lockstep.
 
     Multi-host runs are supported: every process loads the same dataset and
-    derives identical index matrices from the shared seed, and the dataset
-    upload goes through ``mesh.put_replicated``
-    (``make_array_from_process_local_data``), which assembles the global
-    replicated array from per-process copies instead of ``device_put``-ing
-    onto non-addressable devices. Exercised by a real 2-process launch in
-    tests/test_launch.py.
+    derives identical index matrices from the shared seed; the dataset
+    upload goes through ``mesh.put_replicated``, whose cross-process
+    equality check turns divergent per-process data into a loud failure.
+    Exercised by real 2-process launches in tests/test_launch.py.
     """
     if n_samples < global_batch:
         # the per-step path raises this inside EpochIterator; here it would

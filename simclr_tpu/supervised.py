@@ -30,6 +30,7 @@ from simclr_tpu.models.contrastive import SupervisedModel
 from simclr_tpu.ops.lars import get_weight_decay_mask, lars
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
+    MODEL_AXIS,
     batch_sharding,
     mesh_from_config,
     process_local_rows,
@@ -63,6 +64,12 @@ def run_supervised(cfg: Config) -> dict:
     seed = int(cfg.parameter.seed)
 
     mesh = mesh_from_config(cfg)
+    if mesh.shape.get(MODEL_AXIS, 1) > 1 and is_logging_host():
+        logger.warning(
+            "mesh.model=%d: the supervised baseline has no tensor-parallel "
+            "path (the fc head is tiny); model-axis replicas duplicate work. "
+            "Prefer mesh.model=1 here.", mesh.shape[MODEL_AXIS],
+        )
     global_batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
     synthetic_ok = bool(cfg.select("experiment.synthetic_data", False))
     data_dir = cfg.select("experiment.data_dir")

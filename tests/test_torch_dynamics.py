@@ -239,6 +239,122 @@ def test_training_dynamics_match_reference_recipe(torch_init_and_views):
     assert drift < 1.0, f"param drift beyond atol/rtol=5e-3 envelope: {drift}"
 
 
+def test_supervised_dynamics_match_reference_recipe():
+    """Same harness for the SUPERVISED recipe (reference supervised.py:61-127:
+    CE loss on SupervisedModel, identical LARC+SGD+warmup-cosine machinery) —
+    the second headline number's training dynamics."""
+    import torch.nn as tnn
+
+    from simclr_tpu.models.contrastive import SupervisedModel
+    from simclr_tpu.utils.torch_import import import_supervised_state_dict
+    from tests.test_torch_import import _TorchEncoder
+
+    class _TorchSupervised(tnn.Module):
+        def __init__(self, num_classes=10):
+            super().__init__()
+            self.f = _TorchEncoder()
+            self.fc = tnn.Linear(512, num_classes)
+
+        def forward(self, x):
+            return self.fc(self.f(x))
+
+    torch.manual_seed(5)
+    tmodel = _TorchSupervised()
+    variables = jax.tree.map(
+        lambda x: np.array(x, copy=True),
+        import_supervised_state_dict(tmodel.state_dict()),
+    )
+    rng = np.random.default_rng(23)
+    images = [rng.random((BATCH, 32, 32, 3), np.float32) for _ in range(STEPS)]
+    labels = [
+        rng.integers(0, 10, size=BATCH).astype(np.int32) for _ in range(STEPS)
+    ]
+
+    # torch loop
+    decay_flag = {
+        name: not any(s in name for s in ("bias", "bn"))
+        for name, _ in tmodel.named_parameters()
+    }
+    bufs = {name: torch.zeros_like(p) for name, p in tmodel.named_parameters()}
+    torch_losses = []
+    tmodel.train()
+    for i in range(STEPS):
+        lr = reference_lr(i)
+        tmodel.zero_grad()
+        logits = tmodel(torch.from_numpy(images[i].transpose(0, 3, 1, 2)))
+        loss = torch.nn.functional.cross_entropy(
+            logits, torch.from_numpy(labels[i]).long()
+        )
+        loss.backward()
+        with torch.no_grad():
+            for name, p in tmodel.named_parameters():
+                g = p.grad
+                wd = DECAY if decay_flag[name] else 0.0
+                p_norm = torch.norm(p)
+                g_norm = torch.norm(g)
+                if p_norm != 0 and g_norm != 0:
+                    adaptive = TRUST * p_norm / (g_norm + wd * p_norm + EPS)
+                    g = (g + wd * p) * adaptive
+                buf = bufs[name]
+                buf.mul_(MOMENTUM).add_(g)
+                p.add_(buf, alpha=-lr)
+        torch_losses.append(float(loss.detach()))
+
+    # jax loop (reference-exact decay mask: fc.bias excluded by "bias",
+    # fc.weight decayed; no head BN here so the masks only differ on
+    # downsample BN scales)
+    model = SupervisedModel(base_cnn="resnet18", num_classes=10, dtype=jnp.float32)
+    params = jax.tree.map(jnp.asarray, variables["params"])
+    stats = jax.tree.map(jnp.asarray, variables["batch_stats"])
+    schedule = warmup_cosine_schedule(LR0, STEPS, WARMUP)
+    tx = lars(
+        schedule,
+        trust_coefficient=TRUST,
+        weight_decay=DECAY,
+        weight_decay_mask=reference_weight_decay_mask,
+        momentum=MOMENTUM,
+        eps=EPS,
+    )
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y
+            ).mean()
+            return loss, mut["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, new_opt, loss
+
+    jax_losses = []
+    for i in range(STEPS):
+        params, stats, opt_state, loss = step(
+            params, stats, opt_state, jnp.asarray(images[i]), jnp.asarray(labels[i])
+        )
+        jax_losses.append(float(loss))
+
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=1e-3)
+    ours = import_supervised_state_dict(tmodel.state_dict())["params"]
+    atol, rtol = 5e-3, 5e-3
+    excess = jax.tree.map(
+        lambda a, b: float(
+            np.linalg.norm(np.asarray(a) - np.asarray(b))
+            / (atol + rtol * np.linalg.norm(np.asarray(b)))
+        ),
+        params,
+        jax.tree.map(jnp.asarray, ours),
+    )
+    worst = max(jax.tree.leaves(excess))
+    assert worst < 1.0, f"supervised param drift beyond envelope: {worst}"
+
+
 def test_weight_decay_mask_deviation_is_bounded(torch_init_and_views):
     """The structural mask (our default) deviates from the reference's
     substring rule only on the 3 downsample BN scales + head BN scale; over a

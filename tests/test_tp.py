@@ -167,6 +167,29 @@ def test_tp_entrypoint_and_eval_round_trip(tmp_path):
         assert 0.0 <= metrics["val_acc"] <= 1.0
 
 
+@pytest.mark.slow
+def test_tp_resume(tmp_path):
+    """experiment.resume=true under mesh.model=2: the restore template
+    carries the TP layout (head leaves sharded over model), so resuming a
+    tensor-parallel run keeps training where it left off."""
+    from simclr_tpu.main import main as pretrain_main
+
+    save_dir = str(tmp_path / "tp-resume")
+    base = [
+        "experiment.synthetic_data=true",
+        "experiment.synthetic_size=64",
+        "experiment.batches=4",
+        "mesh.model=2",
+        "parameter.warmup_epochs=0",
+        "experiment.save_model_epoch=1",
+        f"experiment.save_dir={save_dir}",
+    ]
+    first = pretrain_main(base + ["parameter.epochs=1"])
+    assert first["steps"] == 4  # data axis 4, global batch 16, 64 samples
+    resumed = pretrain_main(base + ["parameter.epochs=2", "experiment.resume=true"])
+    assert resumed["steps"] == 8  # epoch 2 only: 4 more steps
+
+
 def test_tp_rejects_unsupported_combinations():
     from simclr_tpu.main import run_pretrain
     from simclr_tpu.config import load_config

@@ -89,6 +89,24 @@ def probe_tpu() -> bool:
     return False
 
 
+def parse_last_measurement(stdout: str):
+    """Last valid measurement JSON line of a worker's stdout, or None.
+
+    Skips non-JSON lines and error payloads — a crashed worker's last-ditch
+    JSON must never be accepted as a measurement (tests/test_bench.py).
+    """
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in parsed and "error" not in parsed:
+                return parsed
+    return None
+
+
 def _run_measurement(backend: str, timeout_s: int):
     """Run this file in --worker mode in a subprocess; return parsed JSON or None."""
     env = _cpu_env() if backend == "cpu" else dict(os.environ)
@@ -104,15 +122,9 @@ def _run_measurement(backend: str, timeout_s: int):
     except subprocess.TimeoutExpired:
         print(f"# {backend} measurement timed out after {timeout_s}s", file=sys.stderr)
         return None
-    for line in reversed(r.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                parsed = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if "metric" in parsed and "error" not in parsed:
-                return parsed
+    parsed = parse_last_measurement(r.stdout)
+    if parsed is not None:
+        return parsed
     print(
         f"# {backend} measurement rc={r.returncode}, no JSON; "
         f"stderr tail: {r.stderr.strip()[-500:]}",

@@ -126,6 +126,13 @@ def put_replicated(array, mesh: Mesh) -> jax.Array:
     matrices), so divergent per-process data fails loudly instead of
     training silently. Exercised under 2 real processes by the
     epoch_compile launch tests.
+
+    Cost note: the multi-host equality check allgathers the value across
+    processes once per upload — fine for CIFAR-scale data (~150 MB uint8,
+    once per run). For much larger replicated uploads, switch to a
+    checksum-compare plus ``make_array_from_process_local_data`` (which
+    skips the value check) rather than paying an O(dataset x processes)
+    collective.
     """
     return jax.device_put(np.asarray(array), replicated_sharding(mesh))
 

@@ -1,0 +1,77 @@
+"""Driver bench contract (bench.py).
+
+BENCH_r01 was lost to an unhandled backend-init crash; these tests pin the
+parts of the contract that can regress silently: the worker emits exactly
+one parseable JSON line with the required fields, and the orchestrator's
+parser rejects error payloads (so a crashed worker can never masquerade as
+a measurement and skip the CPU fallback).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _last_json(stdout: str) -> dict:
+    """Parse with the PRODUCTION parser (bench.parse_last_measurement) so the
+    contract test exercises the same scan the orchestrator uses."""
+    import bench
+
+    parsed = bench.parse_last_measurement(stdout)
+    assert parsed is not None, f"no measurement JSON in output:\n{stdout[-2000:]}"
+    return parsed
+
+
+@pytest.mark.slow
+def test_worker_cpu_contract():
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, BENCH, "--worker", "cpu"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    parsed = _last_json(r.stdout)
+    assert parsed["metric"] == "pretrain_imgs_per_sec_per_chip"
+    assert parsed["unit"] == "imgs/sec/chip"
+    assert parsed["backend"] == "cpu"
+    assert parsed["baseline_estimated"] is True
+    assert parsed["value"] > 0
+    assert "error" not in parsed
+
+
+def test_parser_rejects_error_payloads(monkeypatch):
+    """_run_measurement must not accept a last-ditch error JSON as a result."""
+    import bench
+
+    class FakeResult:
+        returncode = 0
+        stdout = json.dumps(
+            {"metric": "pretrain_imgs_per_sec_per_chip", "value": 0.0,
+             "backend": "none", "error": "boom"}
+        )
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: FakeResult())
+    assert bench._run_measurement("tpu", 1) is None
+
+    class GoodResult:
+        returncode = 0
+        stdout = "noise\n" + json.dumps(
+            {"metric": "pretrain_imgs_per_sec_per_chip", "value": 123.0,
+             "backend": "tpu"}
+        )
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: GoodResult())
+    assert bench._run_measurement("tpu", 1)["value"] == 123.0

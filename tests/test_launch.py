@@ -114,9 +114,9 @@ def test_two_process_eval_end_to_end(tmp_path):
 
 def test_two_process_epoch_compile(tmp_path):
     """runtime.epoch_compile under 2 real processes: the replicated dataset
-    upload must go through make_array_from_process_local_data
-    (mesh.put_replicated) — a plain device_put cannot address the peer's
-    devices. Both processes derive identical index matrices from the seed."""
+    upload (mesh.put_replicated) must place onto devices this process cannot
+    address, with both processes deriving identical index matrices from the
+    seed (device_put cross-checks the values match)."""
     save_dir = tmp_path / "ckpts"
     result = _run_launcher(
         [
@@ -132,7 +132,8 @@ def test_two_process_epoch_compile(tmp_path):
             "experiment.synthetic_data=true",
             "experiment.synthetic_size=64",
             f"experiment.save_dir={save_dir}",
-        ]
+        ],
+        timeout=900,  # two epoch-scan compiles on a 1-core host run ~7 min
     )
     assert result.returncode == 0, result.stderr[-2000:]
     assert (save_dir / "epoch=1-cifar10").exists(), result.stderr[-2000:]
@@ -157,7 +158,8 @@ def test_two_process_supervised_epoch_compile(tmp_path):
             "experiment.synthetic_data=true",
             "experiment.synthetic_size=64",
             f"experiment.save_dir={save_dir}",
-        ]
+        ],
+        timeout=900,  # two epoch-scan compiles on a 1-core host run ~7 min
     )
     assert result.returncode == 0, result.stderr[-2000:]
     kept = [p for p in save_dir.iterdir() if p.name.startswith("epoch=")]

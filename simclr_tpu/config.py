@@ -302,8 +302,8 @@ def check_pretrain_conf(cfg: Config) -> None:
     _require(e.decay >= 0, "experiment.decay must be >= 0")
     _require(0.0 <= e.strength <= 1.0, "experiment.strength must be in [0, 1]")
     _require(
-        e.base_cnn in ("resnet18", "resnet50"),
-        f"experiment.base_cnn must be resnet18|resnet50, got {e.base_cnn!r}",
+        e.base_cnn in ("resnet18", "resnet34", "resnet50"),
+        f"experiment.base_cnn must be resnet18|resnet34|resnet50, got {e.base_cnn!r}",
     )
     _require(
         e.name in ("cifar10", "cifar100"),

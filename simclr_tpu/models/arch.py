@@ -1,0 +1,33 @@
+"""ResNet zoo architecture tables — the single source of truth.
+
+Consumed by the Flax encoder (``models/resnet.py``), the torch checkpoint
+importer (``utils/torch_import.py``), and the reference-exact weight-decay
+mask (``ops/lars.py``), so adding an architecture or changing a depth is a
+one-file edit.
+
+The reference zoo is {resnet18, resnet50} (``/root/reference/model.py:87``);
+resnet34 (BasicBlock at resnet50's stage depths) is an addition.
+"""
+
+from __future__ import annotations
+
+STAGE_SIZES: dict[str, tuple[int, int, int, int]] = {
+    "resnet18": (2, 2, 2, 2),
+    "resnet34": (3, 4, 6, 3),
+    "resnet50": (3, 4, 6, 3),
+}
+STAGE_WIDTHS: tuple[int, int, int, int] = (64, 128, 256, 512)
+BASIC_BLOCK_CNNS: tuple[str, ...] = ("resnet18", "resnet34")
+# convs per residual block: 2 for BasicBlock, 3 for Bottleneck — also the
+# Flax auto-index of the projection-shortcut BatchNorm (torch downsample.1)
+CONVS_PER_BLOCK: dict[str, int] = {
+    name: (2 if name in BASIC_BLOCK_CNNS else 3) for name in STAGE_SIZES
+}
+BLOCK_NAME: dict[str, str] = {
+    name: ("BasicBlock" if name in BASIC_BLOCK_CNNS else "BottleneckBlock")
+    for name in STAGE_SIZES
+}
+FEATURE_DIMS: dict[str, int] = {
+    name: (STAGE_WIDTHS[-1] if name in BASIC_BLOCK_CNNS else STAGE_WIDTHS[-1] * 4)
+    for name in STAGE_SIZES
+}

@@ -1,6 +1,7 @@
 """TPU-native ResNet encoders (Flax linen, NHWC, bfloat16 compute).
 
 Provides the backbone capability of the reference's torchvision ResNet-18/50
+(plus ResNet-34, an addition beyond its zoo)
 with CIFAR stem surgery (``/root/reference/model.py:97-111``): a 3x3 stride-1
 stem conv, no stem max-pool, and the classification ``fc`` dropped so the
 encoder emits pooled features ``h``.
@@ -24,7 +25,7 @@ Deviations from the reference, documented:
     7x7 stem that inflates 32x32 inputs to 36x36 maps. We use SAME padding,
     matching the SimCLR paper's CIFAR variant.
   * The reference only applies CIFAR surgery to resnet18
-    (``/root/reference/model.py:90-104``); we apply it to both depths when
+    (``/root/reference/model.py:90-104``); we apply it to every depth when
     ``cifar_stem=True`` since that is the documented intent.
 """
 
@@ -37,11 +38,14 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
-Dtype = Any
+from simclr_tpu.models.arch import (  # single source of truth for the zoo
+    BASIC_BLOCK_CNNS as _BASIC_BLOCK_CNNS,
+    FEATURE_DIMS,
+    STAGE_SIZES as _STAGE_SIZES,
+    STAGE_WIDTHS as _STAGE_WIDTHS,
+)
 
-_STAGE_SIZES = {"resnet18": (2, 2, 2, 2), "resnet50": (3, 4, 6, 3)}
-_STAGE_WIDTHS = (64, 128, 256, 512)
-FEATURE_DIMS = {"resnet18": 512, "resnet50": 2048}
+Dtype = Any
 
 # torch resnets init convs with kaiming_normal(fan_out, relu); reproduce so
 # training dynamics match the reference recipe.
@@ -154,7 +158,9 @@ class ResNetEncoder(nn.Module):
                 f"base_cnn must be one of {sorted(_STAGE_SIZES)}, got {self.base_cnn!r}"
             )
         stage_sizes = _STAGE_SIZES[self.base_cnn]
-        block_cls = BasicBlock if self.base_cnn == "resnet18" else BottleneckBlock
+        block_cls = (
+            BasicBlock if self.base_cnn in _BASIC_BLOCK_CNNS else BottleneckBlock
+        )
         norm = partial(BatchNorm, axis_name=self.bn_cross_replica_axis)
 
         x = x.astype(self.dtype)
@@ -203,5 +209,5 @@ class ResNetEncoder(nn.Module):
 
 
 def feature_dim(base_cnn: str) -> int:
-    """Encoder output dimensionality (512 for resnet18, 2048 for resnet50)."""
+    """Encoder output dimensionality (512 for resnet18/34, 2048 for resnet50)."""
     return FEATURE_DIMS[base_cnn]

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from simclr_tpu.models.arch import CONVS_PER_BLOCK
+
 
 def scale_by_larc(
     trust_coefficient: float = 0.001,
@@ -107,7 +109,7 @@ def reference_weight_decay_mask(params, base_cnn: str = "resnet18") -> Any:
     :func:`simclr_weight_decay_mask` remains the default documented intent.
     Select with ``optimizer.weight_decay_mask=reference``.
     """
-    downsample_bn = f"BatchNorm_{ {'resnet18': 2, 'resnet50': 3}[base_cnn] }"
+    downsample_bn = f"BatchNorm_{CONVS_PER_BLOCK[base_cnn]}"
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
 
     def decide(path) -> bool:

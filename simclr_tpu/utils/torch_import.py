@@ -5,7 +5,7 @@ Lets users of the reference bring their trained ``*.pt`` state dicts
 ``module.`` prefix) straight into this framework for eval/export, and lets
 the test suite check numerical parity model-against-model.
 
-Key mapping (torchvision resnet18/50 + reference heads -> our Flax tree):
+Key mapping (torchvision resnet18/34/50 + reference heads -> our Flax tree):
 
   torchvision                      flax (this repo)
   ------------------------------   -----------------------------------------
@@ -20,7 +20,7 @@ Key mapping (torchvision resnet18/50 + reference heads -> our Flax tree):
   g.projection_head.3.weight       g/linear2/kernel
   fc.{weight,bias}                 fc/{kernel,bias}            (SupervisedModel)
 
-where Block is BasicBlock (resnet18) or BottleneckBlock (resnet50) and ``i``
+where Block is BasicBlock (resnet18/34) or BottleneckBlock (resnet50) and ``i``
 counts blocks across stages in order. torch tensors are converted via
 numpy; torch itself is an optional dependency (only needed to unpickle
 ``.pt`` files — dict inputs work without it).
@@ -32,9 +32,11 @@ from typing import Any, Mapping
 
 import numpy as np
 
-_STAGE_SIZES = {"resnet18": (2, 2, 2, 2), "resnet50": (3, 4, 6, 3)}
-_CONVS_PER_BLOCK = {"resnet18": 2, "resnet50": 3}
-_BLOCK_NAME = {"resnet18": "BasicBlock", "resnet50": "BottleneckBlock"}
+from simclr_tpu.models.arch import (  # single source of truth for the zoo
+    BLOCK_NAME as _BLOCK_NAME,
+    CONVS_PER_BLOCK as _CONVS_PER_BLOCK,
+    STAGE_SIZES as _STAGE_SIZES,
+)
 
 
 def _to_numpy(t) -> np.ndarray:

@@ -52,6 +52,17 @@ def test_resnet18_encoder_shapes_and_params(rng):
     assert n_params(variables["params"]) == RESNET18_CIFAR_ENCODER_PARAMS
 
 
+def test_resnet34_encoder_shapes_and_params(rng):
+    # torchvision resnet34 without fc: 21,284,672 params; CIFAR stem swap
+    # as for resnet18 (addition beyond the reference's {18,50} zoo)
+    enc = ResNetEncoder(base_cnn="resnet34", cifar_stem=True)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = enc.init(rng, x, train=False)
+    h = enc.apply(variables, x, train=False)
+    assert h.shape == (2, 512)
+    assert n_params(variables["params"]) == 21_284_672 - 9408 + 1728
+
+
 def test_resnet50_encoder_shapes_and_params(rng):
     enc = ResNetEncoder(base_cnn="resnet50", cifar_stem=True)
     x = jnp.zeros((2, 32, 32, 3), jnp.float32)

@@ -288,6 +288,13 @@ def _require(cond: bool, message: str) -> None:
         raise ConfigError(message)
 
 
+def _supported_cnns() -> tuple[str, ...]:
+    """Architectures from the single-source zoo table (models/arch.py)."""
+    from simclr_tpu.models.arch import STAGE_SIZES
+
+    return tuple(sorted(STAGE_SIZES))
+
+
 def check_pretrain_conf(cfg: Config) -> None:
     p = cfg.parameter
     _require(p.epochs > 0, "parameter.epochs must be positive")
@@ -302,8 +309,9 @@ def check_pretrain_conf(cfg: Config) -> None:
     _require(e.decay >= 0, "experiment.decay must be >= 0")
     _require(0.0 <= e.strength <= 1.0, "experiment.strength must be in [0, 1]")
     _require(
-        e.base_cnn in ("resnet18", "resnet34", "resnet50"),
-        f"experiment.base_cnn must be resnet18|resnet34|resnet50, got {e.base_cnn!r}",
+        e.base_cnn in _supported_cnns(),
+        f"experiment.base_cnn must be {'|'.join(_supported_cnns())}, "
+        f"got {e.base_cnn!r}",
     )
     _require(
         e.name in ("cifar10", "cifar100"),

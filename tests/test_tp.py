@@ -190,6 +190,39 @@ def test_tp_resume(tmp_path):
     assert resumed["steps"] == 8  # epoch 2 only: 4 more steps
 
 
+@pytest.mark.slow
+def test_dp_checkpoint_resumes_under_tp(tmp_path):
+    """A checkpoint written by a data-parallel run restores into the
+    tensor-parallel layout (orbax reshards the global-view arrays onto the
+    TP template): same global batch on both sides keeps step accounting
+    aligned (dp: 8x4, tp: 4 data shards x 8/device)."""
+    from simclr_tpu.main import main as pretrain_main
+
+    save_dir = str(tmp_path / "dp-to-tp")
+    common = [
+        "experiment.synthetic_data=true",
+        "experiment.synthetic_size=64",
+        "parameter.warmup_epochs=0",
+        "experiment.save_model_epoch=1",
+        f"experiment.save_dir={save_dir}",
+    ]
+    first = pretrain_main(
+        common + ["experiment.batches=4", "parameter.epochs=1"]
+    )
+    assert first["steps"] == 2  # global batch 32 (4 x 8 devices)
+    resumed = pretrain_main(
+        common
+        + [
+            "experiment.batches=8",  # 8 x 4 data shards = same global 32
+            "mesh.model=2",
+            "parameter.epochs=2",
+            "experiment.resume=true",
+        ]
+    )
+    assert resumed["steps"] == 4  # epoch 2 only: 2 more steps
+    assert np.isfinite(resumed["final_loss"])
+
+
 def test_tp_rejects_unsupported_combinations():
     from simclr_tpu.main import run_pretrain
     from simclr_tpu.config import load_config

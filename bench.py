@@ -2,7 +2,12 @@
 
 Times the full compiled train step — on-device two-view augmentation, two
 ResNet-18 forwards, global-negative NT-Xent, backward, psum, LARS — at the
-reference recipe's per-device batch 512, and prints ONE JSON line:
+reference recipe's per-device batch 512. On TPU it measures the step
+variants and reports the fastest semantics-exact one (two_pass or
+two_pass_fused; concat carries a documented BN-semantics deviation and only
+becomes the headline — labeled via the "variant" field — if every exact
+variant failed), with per-variant rates in the payload. Prints ONE JSON
+line:
 
     {"metric": "pretrain_imgs_per_sec_per_chip", "value": ..., "unit":
      "imgs/sec/chip", "vs_baseline": ..., "backend": "tpu"|"cpu", ...}
@@ -119,9 +124,15 @@ def _run_measurement(backend: str, timeout_s: int):
             env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
         print(f"# {backend} measurement timed out after {timeout_s}s", file=sys.stderr)
-        return None
+        # a variant measured BEFORE the hang already printed its payload —
+        # salvage it from the partial stdout
+        partial = exc.stdout.decode() if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+        salvaged = parse_last_measurement(partial)
+        if salvaged is not None:
+            print(f"# salvaged pre-hang measurement: {salvaged}", file=sys.stderr)
+        return salvaged
     parsed = parse_last_measurement(r.stdout)
     if parsed is not None:
         return parsed
@@ -176,7 +187,8 @@ def worker(backend: str) -> None:
         TIMED_STEPS,
         WARMUP_STEPS,
     )
-    if jax.default_backend() == "cpu":
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
         # debug fallback only — the real benchmark runs on TPU; keep the CPU
         # path small enough to finish on a single host core
         per_device_batch, timed_steps, warmup_steps = 16, 5, 2
@@ -189,13 +201,6 @@ def worker(backend: str) -> None:
     lr0 = calculate_initial_lr(1.0, per_device_batch, True)
     schedule = warmup_cosine_schedule(lr0, total_steps=1000, warmup_steps=10)
     tx = lars(schedule, weight_decay=1e-4, weight_decay_mask=simclr_weight_decay_mask)
-    state = create_train_state(
-        model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
-    )
-    state = jax.device_put(state, replicated_sharding(mesh))
-    step = make_pretrain_step(
-        model, tx, mesh, temperature=0.5, strength=0.5, negatives="global"
-    )
 
     ds = synthetic_dataset("cifar10", "train", size=global_batch * 2)
     sharding = batch_sharding(mesh)
@@ -204,42 +209,92 @@ def worker(backend: str) -> None:
         for i in range(2)
     ]
 
-    # Timing must end with an actual device->host VALUE fetch (float(loss)),
-    # not just block_until_ready: on remote-tunneled runtimes the latter can
-    # return before the dispatch queue drains, inflating short-window rates by
-    # >10x. The window is also long (200 steps, ~6s of device time) so that
-    # queueing effects at the margin are amortized.
-    rng = jax.random.key(0)
-    for i in range(warmup_steps):
-        state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, i))
-    float(metrics["loss"])  # drain the dispatch queue
+    def measure(step_kwargs):
+        """imgs/sec/chip of one step variant.
 
-    t0 = time.perf_counter()
-    for i in range(timed_steps):
-        state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, 100 + i))
-    final_loss = float(metrics["loss"])  # value fetch = true synchronization
-    dt = time.perf_counter() - t0
-
-    imgs_per_sec = timed_steps * global_batch / dt
-    per_chip = imgs_per_sec / n_chips
-    assert np.isfinite(final_loss)
-    print(
-        json.dumps(
-            {
-                "metric": "pretrain_imgs_per_sec_per_chip",
-                "value": round(per_chip, 1),
-                "unit": "imgs/sec/chip",
-                "vs_baseline": round(per_chip / REFERENCE_GPU_IMGS_PER_SEC, 3),
-                "backend": jax.default_backend(),
-                "n_chips": n_chips,
-                "per_device_batch": per_device_batch,
-                "timed_steps": timed_steps,
-                "baseline_estimated": True,
-                "baseline_note": "denominator 4000 imgs/sec is an estimated "
-                "V100 rate; reference publishes no throughput (SURVEY §6)",
-            }
+        Timing must end with an actual device->host VALUE fetch
+        (float(loss)), not just block_until_ready: on remote-tunneled
+        runtimes the latter can return before the dispatch queue drains,
+        inflating short-window rates by >10x. The window is long (~6s of
+        device time) so queueing effects at the margin are amortized.
+        """
+        state = create_train_state(
+            model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
         )
-    )
+        state = jax.device_put(state, replicated_sharding(mesh))
+        step = make_pretrain_step(
+            model, tx, mesh, temperature=0.5, strength=0.5, negatives="global",
+            **step_kwargs,
+        )
+        rng = jax.random.key(0)
+        for i in range(warmup_steps):
+            state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, i))
+        float(metrics["loss"])  # drain the dispatch queue
+
+        t0 = time.perf_counter()
+        for i in range(timed_steps):
+            state, metrics = step(
+                state, batches[i % 2], jax.random.fold_in(rng, 100 + i)
+            )
+        final_loss = float(metrics["loss"])  # value fetch = true sync
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final_loss)
+        return timed_steps * global_batch / dt / n_chips
+
+    # On TPU, measure the step variants and report the fastest ELIGIBLE one
+    # — the variant exploration happens wherever the hardware is actually
+    # reachable (the round-1 number was two_pass-only). concat carries a
+    # documented BN-semantics deviation, so it only becomes the headline as
+    # a last resort when every eligible variant failed (still real training
+    # at the reference batch — more honest than a CPU rate — and the
+    # payload's "variant" field labels it). Each variant is isolated so a
+    # kernel failure (e.g. Pallas on a new toolchain) costs that variant
+    # only. CPU fallback: one variant, smallest workload.
+    variants = {"two_pass": {}}
+    eligible = {"two_pass", "two_pass_fused"}
+    if not on_cpu:
+        variants["two_pass_fused"] = {"fused": True}
+        variants["concat"] = {"forward_mode": "concat"}
+
+    def emit(rates, errors):
+        """Best-so-far payload line. Printed after EVERY variant so a later
+        variant that hangs (burning the subprocess timeout) cannot lose the
+        measurements already taken — the orchestrator parses the last
+        complete line from partial stdout."""
+        best_name = max(
+            (n for n in rates if n in eligible), key=lambda n: rates[n],
+            default=None,
+        ) or max(rates, key=lambda n: rates[n])
+        per_chip = rates[best_name]
+        payload = {
+            "metric": "pretrain_imgs_per_sec_per_chip",
+            "value": per_chip,
+            "unit": "imgs/sec/chip",
+            "vs_baseline": round(per_chip / REFERENCE_GPU_IMGS_PER_SEC, 3),
+            "backend": jax.default_backend(),
+            "n_chips": n_chips,
+            "per_device_batch": per_device_batch,
+            "timed_steps": timed_steps,
+            "variant": best_name,
+            "variant_rates": rates,
+            "baseline_estimated": True,
+            "baseline_note": "denominator 4000 imgs/sec is an estimated "
+            "V100 rate; reference publishes no throughput (SURVEY §6)",
+        }
+        if errors:
+            payload["variant_errors"] = errors
+        print(json.dumps(payload), flush=True)
+
+    rates, errors = {}, {}
+    for name, kwargs in variants.items():
+        try:
+            rates[name] = round(measure(kwargs), 1)
+        except Exception as exc:  # noqa: BLE001 — record and continue
+            errors[name] = repr(exc)[:200]
+        if rates:
+            emit(rates, errors)
+    if not rates:
+        raise RuntimeError(f"every variant failed: {errors}")
 
 
 def main() -> None:

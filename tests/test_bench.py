@@ -75,3 +75,30 @@ def test_parser_rejects_error_payloads(monkeypatch):
 
     monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: GoodResult())
     assert bench._run_measurement("tpu", 1)["value"] == 123.0
+
+
+def test_timeout_salvages_pre_hang_measurement(monkeypatch):
+    """A variant that hangs after an earlier variant succeeded must not lose
+    the earlier measurement: the worker prints best-so-far after every
+    variant, and the orchestrator parses the partial stdout on timeout."""
+    import bench
+
+    payload = json.dumps(
+        {"metric": "pretrain_imgs_per_sec_per_chip", "value": 9.0,
+         "backend": "tpu", "variant": "two_pass"}
+    )
+
+    def fake_run(*a, **k):
+        raise subprocess.TimeoutExpired(
+            cmd="worker", timeout=1, output=(payload + "\n").encode()
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    salvaged = bench._run_measurement("tpu", 1)
+    assert salvaged is not None and salvaged["value"] == 9.0
+
+    def fake_run_empty(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="worker", timeout=1, output=None)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run_empty)
+    assert bench._run_measurement("tpu", 1) is None

@@ -128,7 +128,11 @@ def _run_measurement(backend: str, timeout_s: int):
         print(f"# {backend} measurement timed out after {timeout_s}s", file=sys.stderr)
         # a variant measured BEFORE the hang already printed its payload —
         # salvage it from the partial stdout
-        partial = exc.stdout.decode() if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+        partial = (
+            exc.stdout.decode(errors="replace")
+            if isinstance(exc.stdout, bytes)
+            else (exc.stdout or "")
+        )
         salvaged = parse_last_measurement(partial)
         if salvaged is not None:
             print(f"# salvaged pre-hang measurement: {salvaged}", file=sys.stderr)
@@ -180,6 +184,7 @@ def worker(backend: str) -> None:
     )
     from simclr_tpu.parallel.steps import make_pretrain_step
     from simclr_tpu.parallel.train_state import create_train_state
+    from simclr_tpu.utils.profiling import time_step_loop
     from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
 
     per_device_batch, timed_steps, warmup_steps = (
@@ -210,14 +215,9 @@ def worker(backend: str) -> None:
     ]
 
     def measure(step_kwargs):
-        """imgs/sec/chip of one step variant.
-
-        Timing must end with an actual device->host VALUE fetch
-        (float(loss)), not just block_until_ready: on remote-tunneled
-        runtimes the latter can return before the dispatch queue drains,
-        inflating short-window rates by >10x. The window is long (~6s of
-        device time) so queueing effects at the margin are amortized.
-        """
+        """imgs/sec/chip of one step variant (shared sync discipline:
+        utils.profiling.time_step_loop — the window is long, ~6s of device
+        time, so queueing effects at the margin are amortized)."""
         state = create_train_state(
             model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
         )
@@ -226,18 +226,9 @@ def worker(backend: str) -> None:
             model, tx, mesh, temperature=0.5, strength=0.5, negatives="global",
             **step_kwargs,
         )
-        rng = jax.random.key(0)
-        for i in range(warmup_steps):
-            state, metrics = step(state, batches[i % 2], jax.random.fold_in(rng, i))
-        float(metrics["loss"])  # drain the dispatch queue
-
-        t0 = time.perf_counter()
-        for i in range(timed_steps):
-            state, metrics = step(
-                state, batches[i % 2], jax.random.fold_in(rng, 100 + i)
-            )
-        final_loss = float(metrics["loss"])  # value fetch = true sync
-        dt = time.perf_counter() - t0
+        dt, final_loss, _ = time_step_loop(
+            step, state, batches, jax.random.key(0), warmup_steps, timed_steps
+        )
         assert np.isfinite(final_loss)
         return timed_steps * global_batch / dt / n_chips
 

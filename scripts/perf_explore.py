@@ -34,6 +34,7 @@ from simclr_tpu.parallel.mesh import (
 )
 from simclr_tpu.parallel.steps import make_pretrain_epoch_fn, make_pretrain_step
 from simclr_tpu.parallel.train_state import create_train_state
+from simclr_tpu.utils.profiling import time_step_loop
 from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
 
 VARIANTS = {
@@ -55,14 +56,8 @@ def build_state(model, tx, mesh):
 
 
 def time_stepwise(step, state, batches, rng, warmup, steps):
-    for i in range(warmup):
-        state, metrics = step(state, batches[i % len(batches)], rng)
-        float(metrics["loss"])  # drain the queue before starting the clock
-    t0 = time.perf_counter()
-    for i in range(steps):
-        state, metrics = step(state, batches[i % len(batches)], rng)
-    loss = float(metrics["loss"])  # value fetch = reliable fence
-    dt = time.perf_counter() - t0
+    # shared sync discipline with bench.py (value-fetch fences)
+    dt, loss, _ = time_step_loop(step, state, batches, rng, warmup, steps)
     return dt, loss
 
 

@@ -95,6 +95,33 @@ class StepTraceWindow:
             self.enabled = False
 
 
+def time_step_loop(step, state, batches, rng, warmup: int, steps: int):
+    """Time ``steps`` invocations of a compiled ``(state, batch, rng) ->
+    (state, metrics)`` train step with value-fetch synchronization.
+
+    The shared measurement methodology for bench.py and
+    scripts/perf_explore.py: warmup (draining the dispatch queue with a
+    device->host VALUE fetch each iteration — ``block_until_ready`` can
+    return before remote-tunneled dispatch queues drain, inflating
+    short-window rates by >10x), then a timed window closed by a final
+    value fetch. Returns ``(seconds, final_loss, state)``.
+    """
+    import jax
+
+    metrics = None
+    for i in range(warmup):
+        state, metrics = step(state, batches[i % len(batches)], jax.random.fold_in(rng, i))
+        float(metrics["loss"])
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(
+            state, batches[i % len(batches)], jax.random.fold_in(rng, 100 + i)
+        )
+    final_loss = float(metrics["loss"])  # value fetch = true synchronization
+    dt = time.perf_counter() - t0
+    return dt, final_loss, state
+
+
 class StepTimer:
     """Steady-state throughput measurement for a compiled step.
 

@@ -1,8 +1,10 @@
 """Driver bench contract (bench.py).
 
 BENCH_r01 was lost to an unhandled backend-init crash; these tests pin the
-parts of the contract that can regress silently: the worker emits exactly
-one parseable JSON line with the required fields, and the orchestrator's
+parts of the contract that can regress silently: every worker JSON line is
+a complete best-so-far measurement with the required fields (the TPU worker
+intentionally emits one line PER VARIANT so a later hang can't lose earlier
+results — the orchestrator always takes the last), and the orchestrator's
 parser rejects error payloads (so a crashed worker can never masquerade as
 a measurement and skip the CPU fallback).
 """

@@ -140,6 +140,33 @@ def test_two_process_epoch_compile(tmp_path):
     assert result.stderr.count("Epoch:1/1") == 1, result.stderr[-2000:]
 
 
+def test_two_process_tp_pretrain(tmp_path):
+    """mesh.model=2 under 2 real processes (mesh (data=2, model=2) over 2x2
+    devices): TP state layout spans processes, batches upload per-process
+    row blocks, and the jit-level optimizer reduces LARS norms across
+    shards it cannot address locally."""
+    save_dir = tmp_path / "ckpts"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "--coordinator", "127.0.0.1:13401",
+            "-m", "simclr_tpu.main",
+            "mesh.model=2",
+            "parameter.epochs=1",
+            "experiment.batches=8",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert (save_dir / "epoch=1-cifar10").exists(), result.stderr[-2000:]
+    assert result.stderr.count("Epoch:1/1") == 1, result.stderr[-2000:]
+
+
 def test_two_process_supervised_epoch_compile(tmp_path):
     """Supervised epoch_compile under 2 real processes: covers the second
     put_replicated call site (images AND labels), the on-device epoch scan,

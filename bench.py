@@ -27,11 +27,24 @@ block forever, and killing the hung client does not free the device), so:
 
   * the parent process imports no JAX at all — it only orchestrates;
   * the TPU is first probed by a small timed matmul in a subprocess with a
-    hard timeout, retried with backoff;
-  * the measurement itself runs in a subprocess with a hard timeout;
-  * any failure (backend init error, hang, crash) falls back to a CPU-backend
-    measurement, and if even that fails the parent emits a JSON line with
+    hard timeout, retried on an interval for up to ``BENCH_PROBE_BUDGET_S``
+    seconds (VERDICT round 2, item 1: the tunnel's outages last hours and
+    its recoveries are intermittent, so the probe window must dwarf a
+    single attempt — default 40 min when no fallback exists, 7 min when a
+    committed in-round capture would serve instead);
+  * the measurement itself runs in a subprocess with a hard timeout, and a
+    TPU-attempt payload whose ``backend`` is ``"cpu"`` is rejected (a
+    mid-run tunnel death must not smuggle a CPU rate through the TPU path);
+  * every successful live TPU measurement is persisted to
+    ``BENCH_TPU_CAPTURE.json`` so a capture taken mid-round (e.g. by
+    ``scripts/tpu_perf_session.sh`` during a tunnel window) survives to the
+    driver's end-of-round run;
+  * fallback order: live TPU → committed in-round TPU capture (labeled
+    ``"captured": "in_round"``) → CPU measurement → a JSON line with
     ``"backend": "none"`` and the error — ``parsed`` is never null.
+
+Env knobs: ``BENCH_PROBE_BUDGET_S`` (total probing wall-clock budget),
+``BENCH_PROBE_INTERVAL_S`` (sleep between failed probes, default 120 s).
 """
 
 from __future__ import annotations
@@ -48,10 +61,15 @@ TIMED_STEPS = 200
 REFERENCE_GPU_IMGS_PER_SEC = 4000.0  # estimated; see module docstring
 
 PROBE_TIMEOUT_S = 150  # first TPU compile through the tunnel is ~20-40s
-PROBE_ATTEMPTS = 2
-PROBE_BACKOFF_S = 20
+PROBE_INTERVAL_S = 120  # sleep between failed probes (outages are long)
+PROBE_BUDGET_NO_CAPTURE_S = 2400  # no fallback number exists: be patient
+PROBE_BUDGET_WITH_CAPTURE_S = 420  # an in-round TPU capture would serve
 TPU_BENCH_TIMEOUT_S = 900
 CPU_BENCH_TIMEOUT_S = 900
+
+TPU_CAPTURE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CAPTURE.json"
+)
 
 _PROBE_SRC = """
 import jax, jax.numpy as jnp
@@ -68,11 +86,17 @@ def _cpu_env() -> dict:
     return env
 
 
-def probe_tpu() -> bool:
-    """Can the TPU backend init and execute a matmul within the timeout?"""
-    for attempt in range(PROBE_ATTEMPTS):
-        if attempt:
-            time.sleep(PROBE_BACKOFF_S)
+def probe_tpu(budget_s: float, interval_s: float = PROBE_INTERVAL_S) -> bool:
+    """Can the TPU backend init and execute a matmul within the budget?
+
+    One probe attempt is a subprocess matmul with a hard ``PROBE_TIMEOUT_S``
+    timeout; failed attempts repeat every ``interval_s`` until ``budget_s``
+    of wall clock is spent. At least one attempt always runs.
+    """
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
@@ -82,16 +106,64 @@ def probe_tpu() -> bool:
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
         except subprocess.TimeoutExpired:
-            print(f"# TPU probe attempt {attempt + 1}: timed out", file=sys.stderr)
-            continue
-        if r.returncode == 0 and "PROBE_OK" in r.stdout and "cpu" not in r.stdout:
-            return True
-        print(
-            f"# TPU probe attempt {attempt + 1}: rc={r.returncode} "
-            f"out={r.stdout.strip()[-200:]} err={r.stderr.strip()[-200:]}",
-            file=sys.stderr,
-        )
-    return False
+            print(f"# TPU probe attempt {attempt}: timed out", file=sys.stderr)
+        else:
+            if r.returncode == 0 and "PROBE_OK" in r.stdout and "cpu" not in r.stdout:
+                return True
+            print(
+                f"# TPU probe attempt {attempt}: rc={r.returncode} "
+                f"out={r.stdout.strip()[-200:]} err={r.stderr.strip()[-200:]}",
+                file=sys.stderr,
+            )
+        if time.monotonic() + interval_s >= deadline:
+            print(
+                f"# TPU probe budget ({budget_s:.0f}s) exhausted after "
+                f"{attempt} attempts",
+                file=sys.stderr,
+            )
+            return False
+        time.sleep(interval_s)
+
+
+def load_tpu_capture():
+    """Committed in-round TPU measurement, or None.
+
+    Only a genuine TPU payload qualifies (``backend`` present and not
+    cpu/none, no ``error``); the returned copy is labeled
+    ``"captured": "in_round"`` so BENCH_r{N} provenance is explicit.
+    """
+    try:
+        with open(TPU_CAPTURE_PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    payload = data.get("payload") if isinstance(data, dict) else None
+    if not isinstance(payload, dict):
+        return None
+    backend = payload.get("backend")
+    if backend in (None, "cpu", "none") or "error" in payload or "metric" not in payload:
+        return None
+    out = dict(payload)
+    out["captured"] = "in_round"
+    if "captured_at" in data:
+        out["captured_at"] = data["captured_at"]
+    return out
+
+
+def persist_tpu_capture(payload: dict) -> None:
+    """Persist a live TPU measurement for later runs (atomic; best-effort)."""
+    try:
+        data = {
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "payload": payload,
+        }
+        tmp = TPU_CAPTURE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, TPU_CAPTURE_PATH)
+    except OSError as exc:
+        print(f"# could not persist TPU capture: {exc!r}", file=sys.stderr)
 
 
 def parse_last_measurement(stdout: str):
@@ -110,6 +182,23 @@ def parse_last_measurement(stdout: str):
             if "metric" in parsed and "error" not in parsed:
                 return parsed
     return None
+
+
+def _accept(parsed, backend: str):
+    """Reject a TPU-attempt payload that was actually measured on CPU.
+
+    ADVICE r2: if the tunnel dies between probe and worker start and JAX
+    silently falls back to CPU, the honest ``backend`` field is the tell —
+    returning None here routes the orchestrator to its explicit fallback
+    chain instead of accepting a CPU rate as the TPU result.
+    """
+    if parsed is not None and backend == "tpu" and parsed.get("backend") == "cpu":
+        print(
+            "# rejecting tpu-attempt result whose backend field is 'cpu'",
+            file=sys.stderr,
+        )
+        return None
+    return parsed
 
 
 def _run_measurement(backend: str, timeout_s: int):
@@ -133,11 +222,11 @@ def _run_measurement(backend: str, timeout_s: int):
             if isinstance(exc.stdout, bytes)
             else (exc.stdout or "")
         )
-        salvaged = parse_last_measurement(partial)
+        salvaged = _accept(parse_last_measurement(partial), backend)
         if salvaged is not None:
             print(f"# salvaged pre-hang measurement: {salvaged}", file=sys.stderr)
         return salvaged
-    parsed = parse_last_measurement(r.stdout)
+    parsed = _accept(parse_last_measurement(r.stdout), backend)
     if parsed is not None:
         return parsed
     print(
@@ -289,9 +378,30 @@ def worker(backend: str) -> None:
 
 
 def main() -> None:
+    capture = load_tpu_capture()
+    budget = float(
+        os.environ.get(
+            "BENCH_PROBE_BUDGET_S",
+            PROBE_BUDGET_WITH_CAPTURE_S if capture else PROBE_BUDGET_NO_CAPTURE_S,
+        )
+    )
+    interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S", PROBE_INTERVAL_S))
     result = None
-    if probe_tpu():
+    if probe_tpu(budget, interval):
         result = _run_measurement("tpu", TPU_BENCH_TIMEOUT_S)
+        if result is not None:
+            result.setdefault("captured", "live")
+            persist_tpu_capture(result)
+    if result is None:
+        # re-read: a concurrent tpu_perf_session.sh may have persisted a
+        # capture DURING the (up to 40 min) probe window above
+        capture = load_tpu_capture() or capture
+    if result is None and capture is not None:
+        print(
+            "# live TPU unavailable; emitting committed in-round TPU capture",
+            file=sys.stderr,
+        )
+        result = capture
     if result is None:
         print("# falling back to CPU backend", file=sys.stderr)
         result = _run_measurement("cpu", CPU_BENCH_TIMEOUT_S)

@@ -79,6 +79,77 @@ def test_parser_rejects_error_payloads(monkeypatch):
     assert bench._run_measurement("tpu", 1)["value"] == 123.0
 
 
+def test_tpu_attempt_rejects_cpu_backend_payload(monkeypatch):
+    """ADVICE r2: a TPU-attempt worker that silently fell back to CPU must
+    not have its (honestly labeled) CPU payload accepted as the TPU result."""
+    import bench
+
+    class CpuResult:
+        returncode = 0
+        stdout = json.dumps(
+            {"metric": "pretrain_imgs_per_sec_per_chip", "value": 5.0,
+             "backend": "cpu"}
+        )
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: CpuResult())
+    assert bench._run_measurement("tpu", 1) is None
+    # the same payload through the cpu path is a valid measurement
+    assert bench._run_measurement("cpu", 1)["value"] == 5.0
+
+
+def test_probe_budget_runs_at_least_once_and_respects_deadline(monkeypatch):
+    """A zero/tiny budget still probes once; failed probes stop at the
+    deadline instead of sleeping past it."""
+    import bench
+
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(k.get("timeout"))
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    assert bench.probe_tpu(budget_s=0, interval_s=60) is False
+    assert len(calls) == 1 and not sleeps
+
+    class Ok:
+        returncode = 0
+        stdout = "PROBE_OK tpu 1"
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: Ok())
+    assert bench.probe_tpu(budget_s=0) is True
+
+
+def test_in_round_capture_roundtrip(monkeypatch, tmp_path):
+    """persist → load round trip labels the payload captured:'in_round';
+    CPU/error/absent captures are not served."""
+    import bench
+
+    path = tmp_path / "BENCH_TPU_CAPTURE.json"
+    monkeypatch.setattr(bench, "TPU_CAPTURE_PATH", str(path))
+    assert bench.load_tpu_capture() is None  # absent
+
+    good = {"metric": "pretrain_imgs_per_sec_per_chip", "value": 16000.0,
+            "unit": "imgs/sec/chip", "backend": "tpu", "captured": "live"}
+    bench.persist_tpu_capture(good)
+    loaded = bench.load_tpu_capture()
+    assert loaded is not None
+    assert loaded["value"] == 16000.0
+    assert loaded["captured"] == "in_round"
+    assert "captured_at" in loaded
+
+    bench.persist_tpu_capture({**good, "backend": "cpu"})
+    assert bench.load_tpu_capture() is None
+    bench.persist_tpu_capture({**good, "error": "boom"})
+    assert bench.load_tpu_capture() is None
+    path.write_text("not json")
+    assert bench.load_tpu_capture() is None
+
+
 def test_timeout_salvages_pre_hang_measurement(monkeypatch):
     """A variant that hangs after an earlier variant succeeded must not lose
     the earlier measurement: the worker prints best-so-far after every

@@ -31,3 +31,9 @@ FEATURE_DIMS: dict[str, int] = {
     name: (STAGE_WIDTHS[-1] if name in BASIC_BLOCK_CNNS else STAGE_WIDTHS[-1] * 4)
     for name in STAGE_SIZES
 }
+# stages whose first block carries a projection shortcut (torch `downsample`):
+# stages 2-4 always stride; stage 1 only widens channels for Bottleneck (the
+# CIFAR stem outputs 64 = BasicBlock stage-1 width, but Bottleneck expands ×4)
+DOWNSAMPLE_STAGES: dict[str, int] = {
+    name: (3 if name in BASIC_BLOCK_CNNS else 4) for name in STAGE_SIZES
+}

@@ -115,8 +115,11 @@ class ProjectionHead(nn.Module):
         )(y)
         if self.tp_axis is not None:
             # row-parallel contraction: each shard holds a partial sum over
-            # its slice of the hidden dim; g operator completes it
-            y = _tp_boundary_out(self.tp_axis)(y)
+            # its slice of the hidden dim; g operator completes it. Cast up
+            # first: the unsharded head accumulates the full contraction
+            # inside the matmul, so summing shard partials in bf16 would be
+            # a TP-only numerical deviation (cheap — y is (B, d)).
+            y = _tp_boundary_out(self.tp_axis)(y.astype(jnp.float32))
         return y.astype(jnp.float32)
 
 

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from simclr_tpu.models.arch import CONVS_PER_BLOCK
+from simclr_tpu.models.arch import CONVS_PER_BLOCK, DOWNSAMPLE_STAGES
 
 
 def scale_by_larc(
@@ -126,6 +126,32 @@ def reference_weight_decay_mask(params, base_cnn: str = "resnet18") -> Any:
         return True
 
     decisions = [decide(path) for path, _ in flat]
+
+    # The substring rule keys off Flax auto-index names, so a rename or
+    # reordering in resnet.py/heads.py would silently change which scales
+    # decay (ADVICE r2). Pin the count structurally: one decayed scale per
+    # projection-shortcut stage, plus the head BN iff the tree has one.
+    def _leaf(path) -> str:
+        return str(
+            next(p.key for p in reversed(path) if isinstance(p, jax.tree_util.DictKey))
+        )
+
+    decayed_scales = sum(
+        1 for (path, _), d in zip(flat, decisions) if d and _leaf(path) == "scale"
+    )
+    has_head = any(
+        str(path[0].key) == "g"
+        for path, _ in flat
+        if path and isinstance(path[0], jax.tree_util.DictKey)
+    )
+    expected = DOWNSAMPLE_STAGES[base_cnn] + (1 if has_head else 0)
+    if decayed_scales != expected:
+        raise ValueError(
+            f"reference_weight_decay_mask matched {decayed_scales} decayed norm "
+            f"scales but {base_cnn} should have {expected} "
+            f"({DOWNSAMPLE_STAGES[base_cnn]} projection-shortcut BNs"
+            f"{' + head bn1' if has_head else ''}) — module naming drifted?"
+        )
     treedef = jax.tree_util.tree_structure(params)
     return jax.tree_util.tree_unflatten(treedef, decisions)
 

@@ -169,11 +169,14 @@ def run_pretrain(cfg: Config) -> dict:
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     data_shard = batch_sharding(mesh)
     if n_model > 1:
-        # tensor-parallel projection head over the model axis (parallel/tp.py)
-        from simclr_tpu.parallel.tp import make_pretrain_step_tp
+        # tensor-parallel projection head over the model axis (parallel/tp.py).
+        # Support matrix: docs/PERF.md §"Tensor-parallel support matrix"
+        from simclr_tpu.parallel.tp import (
+            make_pretrain_epoch_fn_tp,
+            make_pretrain_step_tp,
+        )
 
         unsupported = {
-            "runtime.epoch_compile": epoch_compile,
             "loss.fused": step_kwargs["fused"],
             "model.remat": step_kwargs["remat"],
             "loss.negatives != global": step_kwargs["negatives"] != "global",
@@ -183,17 +186,30 @@ def run_pretrain(cfg: Config) -> dict:
         if bad:
             raise ValueError(
                 f"mesh.model={n_model} (tensor parallelism) does not combine "
-                f"with: {', '.join(bad)}"
+                f"with: {', '.join(bad)} "
+                "(see docs/PERF.md, tensor-parallel support matrix)"
             )
-        step_fn = make_pretrain_step_tp(
-            model, tx, mesh,
-            temperature=step_kwargs["temperature"],
-            strength=step_kwargs["strength"],
-        )
-        iterator = EpochIterator(
-            dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
-            gather_threads=int(cfg.parameter.num_workers),
-        )
+        if epoch_compile:
+            check_epoch_compile_preconditions(
+                len(dataset), global_batch, cfg.select("experiment.profile_dir")
+            )
+            epoch_fn = make_pretrain_epoch_fn_tp(
+                model, tx, mesh,
+                temperature=step_kwargs["temperature"],
+                strength=step_kwargs["strength"],
+            )
+            images_all = put_replicated(dataset.images, mesh)
+            iterator = None
+        else:
+            step_fn = make_pretrain_step_tp(
+                model, tx, mesh,
+                temperature=step_kwargs["temperature"],
+                strength=step_kwargs["strength"],
+            )
+            iterator = EpochIterator(
+                dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
+                gather_threads=int(cfg.parameter.num_workers),
+            )
     elif epoch_compile:
         check_epoch_compile_preconditions(
             len(dataset), global_batch, cfg.select("experiment.profile_dir")
@@ -297,6 +313,7 @@ def run_pretrain(cfg: Config) -> dict:
         "save_dir": save_dir,
         "global_batch": global_batch,
         "n_data_shards": n_data,
+        "lr0": lr0,
         "imgs_per_sec_steady": throughput["imgs_per_sec"],
     }
 

@@ -114,23 +114,19 @@ def _local_view(model, tp: int):
     return model.clone(head_hidden=hidden // tp, head_tp_axis=MODEL_AXIS)
 
 
-def make_pretrain_step_tp(
+def _make_step_body(
     model,
     tx: optax.GradientTransformation,
     mesh,
     *,
-    temperature: float = 0.5,
-    strength: float = 0.5,
-    out_size: int = 32,
-) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
-    """Contrastive train step with the projection head tensor-parallel over
-    the ``model`` mesh axis (global NT-Xent negatives over ``data``).
-
-    Same contract as :func:`simclr_tpu.parallel.steps.make_pretrain_step`:
-    ``(state, images_u8, rng) -> (state, metrics)``; ``state`` must be laid
-    out with :func:`tp_state_shardings`. With ``model=1`` this degenerates to
-    the data-parallel step (tested equivalent).
-    """
+    temperature: float,
+    strength: float,
+    out_size: int,
+):
+    """The un-jitted TP step: shard_map'ed forward/backward + jit-level
+    optimizer update. Shared by the dispatch-per-step and epoch-compiled
+    paths so their numerics can never diverge (same pattern as
+    ``steps._make_local_pretrain_step``)."""
     tp = mesh.shape[MODEL_AXIS]
     local_model = _local_view(model, tp)
 
@@ -183,4 +179,73 @@ def make_pretrain_step_tp(
         )
         return new_state, {"loss": loss}
 
+    return step
+
+
+def make_pretrain_step_tp(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
+    """Contrastive train step with the projection head tensor-parallel over
+    the ``model`` mesh axis (global NT-Xent negatives over ``data``).
+
+    Same contract as :func:`simclr_tpu.parallel.steps.make_pretrain_step`:
+    ``(state, images_u8, rng) -> (state, metrics)``; ``state`` must be laid
+    out with :func:`tp_state_shardings`. With ``model=1`` this degenerates to
+    the data-parallel step (tested equivalent).
+    """
+    step = _make_step_body(
+        model, tx, mesh,
+        temperature=temperature, strength=strength, out_size=out_size,
+    )
     return jax.jit(step, donate_argnums=(0,))
+
+
+def make_pretrain_epoch_fn_tp(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> Callable[..., tuple[TrainState, dict]]:
+    """Epoch-compiled TP training: ``lax.scan`` over steps at the JIT level.
+
+    Same contract as :func:`simclr_tpu.parallel.steps.make_pretrain_epoch_fn`
+    — ``(state, images_all, idx_epoch, base_key, step0) -> (state,
+    {"loss": (steps,)})`` with ``images_all`` the full replicated uint8
+    dataset. Structure differs from the dp epoch fn by necessity: the dp
+    path wraps the WHOLE scan in one shard_map, but the TP optimizer update
+    must run at the jit level (LARS trust-ratio norms over the GLOBAL head
+    arrays — see module docstring), so here the scan lives at the jit level
+    and each body iteration re-enters shard_map for the forward/backward
+    only. The per-step batch is gathered by index at the jit level and
+    constrained to the data-axis sharding the step expects; RNG streams
+    (``fold_in(base_key, step0 + i)``) match the per-step loop exactly.
+    """
+    step = _make_step_body(
+        model, tx, mesh,
+        temperature=temperature, strength=strength, out_size=out_size,
+    )
+    batched = NamedSharding(mesh, P(DATA_AXIS))
+
+    def epoch(state: TrainState, images_all, idx_epoch, base_key, step0):
+        def body(state, xs):
+            idx_step, i = xs
+            batch = jax.lax.with_sharding_constraint(
+                jnp.take(images_all, idx_step, axis=0), batched
+            )
+            return step(state, batch, jax.random.fold_in(base_key, step0 + i))
+
+        steps = idx_epoch.shape[0]
+        return jax.lax.scan(
+            body, state, (idx_epoch, jnp.arange(steps, dtype=jnp.int32))
+        )
+
+    return jax.jit(epoch, donate_argnums=(0,))

@@ -25,6 +25,7 @@ from simclr_tpu.parallel.mesh import (
     create_mesh,
 )
 from simclr_tpu.parallel.tp import (
+    make_pretrain_epoch_fn_tp,
     make_pretrain_step_tp,
     state_pspecs,
     tp_state_shardings,
@@ -221,6 +222,88 @@ def test_dp_checkpoint_resumes_under_tp(tmp_path):
     )
     assert resumed["steps"] == 4  # epoch 2 only: 2 more steps
     assert np.isfinite(resumed["final_loss"])
+
+
+@pytest.mark.slow
+def test_tp_epoch_compile_matches_per_step():
+    """make_pretrain_epoch_fn_tp == the per-step TP loop: same batches (by
+    index matrix) and RNG streams (fold_in(base, step0+i)), so per-step
+    losses and final params must agree to float tolerance. Pins the one
+    structural difference — scan at jit level re-entering shard_map per
+    step, optimizer update outside shard_map both ways."""
+    mesh = create_mesh(MeshSpec(data=2, model=4))
+    model = ContrastiveModel(
+        base_cnn="resnet18", d=128, dtype=jnp.float32,
+        bn_cross_replica_axis=DATA_AXIS,
+    )
+    tx = lars(
+        warmup_cosine_schedule(0.1, 20, 2),
+        weight_decay=1e-4,
+        weight_decay_mask=simclr_weight_decay_mask,
+    )
+
+    def fresh_state():
+        s = create_train_state(
+            model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
+        )
+        return jax.device_put(s, tp_state_shardings(mesh, s))
+
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(16, 32, 32, 3), dtype=np.uint8
+    )
+    idx = np.asarray(
+        [[3, 1, 8, 9, 12, 0, 5, 7], [2, 4, 6, 10, 11, 13, 14, 15]], np.int32
+    )
+    base = jax.random.key(42)
+
+    step = make_pretrain_step_tp(model, tx, mesh)
+    state_a = fresh_state()
+    losses_a = []
+    for i in range(idx.shape[0]):
+        batch = jax.device_put(images[idx[i]], batch_sharding(mesh))
+        state_a, m = step(state_a, batch, jax.random.fold_in(base, i))
+        losses_a.append(float(m["loss"]))
+
+    epoch_fn = make_pretrain_epoch_fn_tp(model, tx, mesh)
+    state_b, hist = epoch_fn(
+        fresh_state(), jnp.asarray(images), jnp.asarray(idx), base, 0
+    )
+    np.testing.assert_allclose(np.asarray(hist["loss"]), losses_a, rtol=1e-4)
+
+    flat_a = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_leaves_with_path(jax.device_get(state_a.params))
+    )
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+        jax.device_get(state_b.params)
+    ):
+        key = jax.tree_util.keystr(path)
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_a[key]), atol=2e-5, err_msg=key
+        )
+
+
+@pytest.mark.slow
+def test_tp_epoch_compile_entrypoint(tmp_path):
+    """mesh.model=2 + runtime.epoch_compile=true end to end through main."""
+    from simclr_tpu.main import main as pretrain_main
+
+    save_dir = str(tmp_path / "tp-ec")
+    summary = pretrain_main(
+        [
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            "experiment.batches=4",
+            "mesh.model=2",
+            "runtime.epoch_compile=true",
+            "parameter.epochs=1",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    assert summary["steps"] == 64 // (4 * 4)
+    assert np.isfinite(summary["final_loss"])
 
 
 def test_tp_rejects_unsupported_combinations():

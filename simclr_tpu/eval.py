@@ -170,19 +170,50 @@ def _probe_program(
     else:
         clf = NonLinearClassifier(num_classes=num_classes)
     has_bn = kind != "linear"
+    bn_eps = 1e-5
+    bn_momentum = 0.9  # torch BatchNorm1d momentum 0.1 == keep 0.9
 
     pad = steps_per_epoch * batch - n
     mask_np = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
     mask_epoch = mask_np.reshape(steps_per_epoch, batch)
 
+    # The nonlinear probe's BN runs FUNCTIONALLY on the NonLinearClassifier
+    # param/stat trees rather than through flax's BatchNorm, for exact
+    # reference semantics under the static-shape scan (probe-dynamics
+    # parity, tests/test_probe_dynamics.py): the reference's drop_last=False
+    # tail batch is SMALLER, so its BN statistics span only the real rows —
+    # here the padded rows must be masked out of the batch mean/var — and
+    # torch's running_var update uses the UNBIASED batch variance
+    # (flax's uses the biased one).
+    def _mlp_train_forward(p, stats, xb, mask):
+        y = xb @ p["linear1"]["kernel"] + p["linear1"]["bias"]
+        m = mask[:, None]
+        n_real = jnp.maximum(mask.sum(), 1.0)
+        mean = (y * m).sum(axis=0) / n_real
+        var = (jnp.square(y - mean) * m).sum(axis=0) / n_real
+        yn = (y - mean) * jax.lax.rsqrt(var + bn_eps)
+        yn = yn * p["bn1"]["scale"] + p["bn1"]["bias"]
+        unbiased = var * n_real / jnp.maximum(n_real - 1.0, 1.0)
+        new_stats = {
+            "bn1": {
+                "mean": bn_momentum * stats["bn1"]["mean"] + (1 - bn_momentum) * mean,
+                "var": bn_momentum * stats["bn1"]["var"]
+                + (1 - bn_momentum) * unbiased,
+            }
+        }
+        logits = jax.nn.relu(yn) @ p["linear2"]["kernel"] + p["linear2"]["bias"]
+        return logits, new_stats
+
+    def _mlp_eval_forward(p, stats, X):
+        y = X @ p["linear1"]["kernel"] + p["linear1"]["bias"]
+        yn = (y - stats["bn1"]["mean"]) * jax.lax.rsqrt(stats["bn1"]["var"] + bn_eps)
+        yn = yn * p["bn1"]["scale"] + p["bn1"]["bias"]
+        return jax.nn.relu(yn) @ p["linear2"]["kernel"] + p["linear2"]["bias"]
+
     def train_step(params, opt_state, batch_stats, xb, yb, mask):
         def loss_fn(p):
             if has_bn:
-                logits, mut = clf.apply(
-                    {"params": p, "batch_stats": batch_stats}, xb, train=True,
-                    mutable=["batch_stats"],
-                )
-                new_stats = mut["batch_stats"]
+                logits, new_stats = _mlp_train_forward(p, batch_stats, xb, mask)
             else:
                 logits = clf.apply({"params": p}, xb)
                 new_stats = batch_stats
@@ -199,9 +230,7 @@ def _probe_program(
 
     def dataset_metrics(params, batch_stats, Xs, ys):
         if has_bn:
-            logits = clf.apply(
-                {"params": params, "batch_stats": batch_stats}, Xs, train=False
-            )
+            logits = _mlp_eval_forward(params, batch_stats, Xs)
         else:
             logits = clf.apply({"params": params}, Xs)
         logits = logits.astype(jnp.float32)

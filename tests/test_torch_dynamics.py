@@ -166,7 +166,7 @@ def run_jax_loop(variables, views_np, mask_fn):
             params, stats, opt_state, jnp.asarray(v0), jnp.asarray(v1)
         )
         losses.append(float(loss))
-    return losses, params
+    return losses, params, stats
 
 
 # ---------------------------------------------------------------------------
@@ -233,7 +233,7 @@ def _param_drift(params, torch_model, atol=5e-3, rtol=5e-3):
 def test_training_dynamics_match_reference_recipe(torch_init_and_views):
     torch_model, variables, views_np, views_t = torch_init_and_views
     # reference-exact weight-decay mask -> tight tracking
-    jax_losses, jax_params = run_jax_loop(
+    jax_losses, jax_params, _ = run_jax_loop(
         variables, views_np, reference_weight_decay_mask
     )
     torch_losses = run_torch_loop(torch_model, views_t)
@@ -257,7 +257,7 @@ def test_long_horizon_drift_stays_bounded():
     envelope (see PARITY.md)."""
     model, variables, views_np, views_t = _make_init_and_views(32, view_seed=41)
 
-    jax_losses, jax_params = run_jax_loop(
+    jax_losses, jax_params, _ = run_jax_loop(
         variables, views_np, reference_weight_decay_mask
     )
     torch_losses = run_torch_loop(model, views_t)
@@ -380,8 +380,8 @@ def test_weight_decay_mask_deviation_is_bounded(torch_init_and_views):
     short loop the induced param divergence must be tiny (and measurably
     nonzero — this is a real, documented deviation, not a no-op)."""
     _, variables, views_np, _ = torch_init_and_views
-    _, params_ref = run_jax_loop(variables, views_np, reference_weight_decay_mask)
-    _, params_struct = run_jax_loop(variables, views_np, simclr_weight_decay_mask)
+    _, params_ref, _ = run_jax_loop(variables, views_np, reference_weight_decay_mask)
+    _, params_struct, _ = run_jax_loop(variables, views_np, simclr_weight_decay_mask)
 
     rel = jax.tree.map(
         lambda a, b: float(

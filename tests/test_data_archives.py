@@ -1,0 +1,149 @@
+"""Genuine-archive ingestion (data/cifar.py `_maybe_extract` + readers).
+
+Round-2 gap (VERDICT r2 item 5): the reader had only ever been tested
+against a pre-extracted pickle, so the tar.gz extraction branch and the
+CIFAR-100 member naming would have met the real artifacts for the first
+time on expensive hardware. These fixtures mirror the published archives
+byte-structurally: a ``cifar-10-python.tar.gz`` whose members are
+``cifar-10-batches-py/{data_batch_1..5, test_batch}`` and a
+``cifar-100-python.tar.gz`` with ``cifar-100-python/{train, test}``; the
+member pickles carry Python-2-era BYTES keys (``b"data"``,
+``b"labels"``/``b"fine_labels"``…) exactly as ``pickle.load(...,
+encoding="bytes")`` yields them from the real files, including the keys the
+reader must ignore (``b"batch_label"``, ``b"filenames"``,
+``b"coarse_labels"``).
+
+Reference behavior being pinned: torchvision's CIFAR10/100 loaders consume
+the same archives (``/root/reference/main.py:158-165``); CIFAR-100 labels
+are the FINE labels (100-way), not the coarse ones.
+"""
+
+import io
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from simclr_tpu.data.cifar import load_dataset
+
+
+def _chw_rows(values: list[tuple[int, int, int]]) -> np.ndarray:
+    """One 3072-byte CHW-flat row per (r, g, b) constant-color image."""
+    rows = []
+    for r, g, b in values:
+        chw = np.empty((3, 32, 32), dtype=np.uint8)
+        chw[0], chw[1], chw[2] = r, g, b
+        rows.append(chw.reshape(-1))
+    return np.stack(rows)
+
+
+def _add_pickle_member(tar: tarfile.TarFile, name: str, obj: dict) -> None:
+    # protocol 2 matches the Python-2-generated originals' loadability;
+    # bytes keys reproduce what encoding="bytes" yields from them
+    payload = pickle.dumps(obj, protocol=2)
+    info = tarfile.TarInfo(name)
+    info.size = len(payload)
+    tar.addfile(info, io.BytesIO(payload))
+
+
+@pytest.fixture
+def cifar10_archive(tmp_path):
+    """cifar-10-python.tar.gz: 5 train batches x 2 rows + 2 test rows.
+
+    Colors encode provenance: batch i's rows are (10i, 100+i, 200+i) and
+    (10i+5, 100+i, 200+i) so the NHWC transpose AND the batch
+    concatenation order are both asserted by pixel values.
+    """
+    with tarfile.open(tmp_path / "cifar-10-python.tar.gz", "w:gz") as tar:
+        for i in range(1, 6):
+            rows = _chw_rows([(10 * i, 100 + i, 200 + i), (10 * i + 5, 100 + i, 200 + i)])
+            _add_pickle_member(
+                tar,
+                f"cifar-10-batches-py/data_batch_{i}",
+                {
+                    b"batch_label": f"training batch {i} of 5".encode(),
+                    b"labels": [i % 10, (i + 1) % 10],
+                    b"data": rows,
+                    b"filenames": [b"a.png", b"b.png"],
+                },
+            )
+        _add_pickle_member(
+            tar,
+            "cifar-10-batches-py/test_batch",
+            {
+                b"batch_label": b"testing batch 1 of 1",
+                b"labels": [7, 8],
+                b"data": _chw_rows([(1, 2, 3), (4, 5, 6)]),
+                b"filenames": [b"t0.png", b"t1.png"],
+            },
+        )
+    return tmp_path
+
+
+@pytest.fixture
+def cifar100_archive(tmp_path):
+    with tarfile.open(tmp_path / "cifar-100-python.tar.gz", "w:gz") as tar:
+        for split, labels, coarse in (
+            ("train", [42, 99, 0], [4, 9, 0]),
+            ("test", [17, 3], [1, 0]),
+        ):
+            colors = [(20 * k, 21 * k, 22 * k) for k in range(1, len(labels) + 1)]
+            _add_pickle_member(
+                tar,
+                f"cifar-100-python/{split}",
+                {
+                    b"data": _chw_rows(colors),
+                    b"fine_labels": labels,
+                    b"coarse_labels": coarse,
+                    b"filenames": [b"x.png"] * len(labels),
+                },
+            )
+    return tmp_path
+
+
+def test_cifar10_tar_extraction_end_to_end(cifar10_archive):
+    data_dir = str(cifar10_archive)
+    assert not os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py"))
+    train = load_dataset("cifar10", "train", data_dir=data_dir)
+    assert train.images.shape == (10, 32, 32, 3)
+    assert train.images.dtype == np.uint8
+    assert train.labels.dtype == np.int32
+    assert not train.synthetic
+    # batch order: rows 0-1 from data_batch_1, rows 8-9 from data_batch_5
+    assert train.labels.tolist() == [1, 2, 2, 3, 3, 4, 4, 5, 5, 6]
+    # NHWC transpose: row 0 of batch 1 is R=10, G=101, B=201 everywhere
+    assert (train.images[0, :, :, 0] == 10).all()
+    assert (train.images[0, :, :, 1] == 101).all()
+    assert (train.images[0, :, :, 2] == 201).all()
+    assert (train.images[9, :, :, 0] == 55).all()  # batch 5, second row
+
+    test = load_dataset("cifar10", "test", data_dir=data_dir)
+    assert test.images.shape == (2, 32, 32, 3)
+    assert test.labels.tolist() == [7, 8]
+    assert (test.images[1, :, :, 2] == 6).all()
+
+    # extraction is idempotent: a second load reads the extracted dir
+    again = load_dataset("cifar10", "train", data_dir=data_dir)
+    np.testing.assert_array_equal(again.images, train.images)
+
+
+def test_cifar100_tar_extraction_uses_fine_labels(cifar100_archive):
+    data_dir = str(cifar100_archive)
+    train = load_dataset("cifar100", "train", data_dir=data_dir)
+    assert train.images.shape == (3, 32, 32, 3)
+    # fine_labels, NOT coarse_labels (reference uses torchvision CIFAR100,
+    # whose targets are the 100-way fine labels)
+    assert train.labels.tolist() == [42, 99, 0]
+    assert train.num_classes == 100
+    assert (train.images[2, :, :, 0] == 60).all()
+    assert (train.images[2, :, :, 1] == 63).all()
+
+    test = load_dataset("cifar100", "test", data_dir=data_dir)
+    assert test.labels.tolist() == [17, 3]
+
+
+def test_missing_archive_still_raises_without_synthetic(tmp_path):
+    with pytest.raises(FileNotFoundError, match="archives not found"):
+        load_dataset("cifar10", "train", data_dir=str(tmp_path / "nope"))

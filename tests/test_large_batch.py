@@ -1,0 +1,52 @@
+"""Large-batch recipe structural rehearsal (VERDICT r2 item 6).
+
+The ``cifar10-large-batch`` config (global 4096, sqrt LR scaling on the
+GLOBAL batch, remat, global/ring negatives — BASELINE.json config 5) had
+only config-parsing tests; its knob COMBINATION had never executed. This
+runs the recipe scaled down to the 8-shard CPU mesh — global 512
+(64/device), ``model.remat=true``, ``parameter.lr_scale_batch=global``,
+sqrt scaling — asserting the composed program runs, the loss is finite,
+and lr0 is the recipe's 0.075·√512, so the pod-scale run cannot die on an
+incoherent flag set or a mis-scaled LR.
+
+Reference recipe anchor: SimCLR's large-batch LARS setup (paper appendix
+B.1; ``conf/experiment/cifar10-large-batch.yaml`` documents the mapping —
+the reference repo itself has no large-batch config, SURVEY §2.4).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from simclr_tpu.main import main as pretrain_main
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.parametrize(
+    "negatives,fused",
+    [("ring", False), ("global", True)],
+    ids=["ring", "global-fused"],
+)
+def test_large_batch_recipe_rehearsal(tmp_path, negatives, fused):
+    summary = pretrain_main(
+        [
+            "experiment=cifar10-large-batch",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=512",
+            "experiment.batches=64",  # 8 data shards -> global 512
+            "model.remat=true",
+            f"loss.negatives={negatives}",
+            f"loss.fused={str(fused).lower()}",
+            "parameter.epochs=1",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            f"experiment.save_dir={tmp_path / negatives}",
+        ]
+    )
+    assert summary["global_batch"] == 512
+    assert summary["steps"] == 1
+    assert np.isfinite(summary["final_loss"])
+    # sqrt scaling on the GLOBAL batch: 0.075 * sqrt(512)
+    assert summary["lr0"] == pytest.approx(0.075 * math.sqrt(512))

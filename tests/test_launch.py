@@ -9,6 +9,7 @@ overrides, fail-fast on child failure (``/root/reference/launch.py:255-259``).
 """
 
 import os
+import socket
 import subprocess
 import sys
 
@@ -29,10 +30,20 @@ def _launcher_env():
     }
 
 
+def _coordinator() -> str:
+    """OS-assigned ephemeral coordinator port: a fixed port collides with
+    stale coordinators from killed runs or concurrent pytest invocations,
+    presenting as flaky rendezvous timeouts (ADVICE r2)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"127.0.0.1:{s.getsockname()[1]}"
+
+
 def _run_launcher(args, timeout=420):
     env = _launcher_env()
     return subprocess.run(
-        [sys.executable, "-m", "simclr_tpu.launch", *args],
+        [sys.executable, "-m", "simclr_tpu.launch",
+         "--coordinator", _coordinator(), *args],
         cwd=REPO,
         env=env,
         capture_output=True,
@@ -47,7 +58,6 @@ def test_two_process_pretrain_end_to_end(tmp_path):
         [
             "--nprocs", "2",
             "--devices-per-proc", "2",
-            "--coordinator", "127.0.0.1:13331",
             "-m", "simclr_tpu.main",
             "parameter.epochs=1",
             "experiment.batches=8",
@@ -75,7 +85,6 @@ def test_two_process_eval_end_to_end(tmp_path):
         [
             "--nprocs", "2",
             "--devices-per-proc", "2",
-            "--coordinator", "127.0.0.1:13371",
             "-m", "simclr_tpu.main",
             "parameter.epochs=1",
             "experiment.batches=8",
@@ -92,7 +101,6 @@ def test_two_process_eval_end_to_end(tmp_path):
         [
             "--nprocs", "2",
             "--devices-per-proc", "2",
-            "--coordinator", "127.0.0.1:13372",
             "-m", "simclr_tpu.eval",
             "parameter.classifier=centroid",
             "experiment.batches=8",
@@ -112,6 +120,84 @@ def test_two_process_eval_end_to_end(tmp_path):
     assert 0.0 <= ckpt_results["val_acc"] <= 1.0
 
 
+def test_two_process_linear_probe_and_save_features(tmp_path):
+    """The two entry surfaces round 2 left untested under real processes
+    (VERDICT r2 item 4): `eval` with classifier=linear — learnable_probe
+    trains on the full replicated feature matrix per process, so the
+    host-local `jnp.asarray` upload feeding an unsharded jit must behave
+    identically on both — and `save_features`, whose augmented-features
+    input side reuses put_global_batch with per-process row blocks. One
+    shared pretrain keeps the wall-clock down."""
+    save_dir = tmp_path / "ckpts"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "-m", "simclr_tpu.main",
+            "parameter.epochs=1",
+            "experiment.batches=8",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+
+    eval_dir = tmp_path / "eval"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "-m", "simclr_tpu.eval",
+            "parameter.classifier=linear",
+            "parameter.epochs=2",
+            "experiment.batches=8",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.target_dir={save_dir}",
+            f"experiment.save_dir={eval_dir}",
+        ],
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    import json
+
+    (results_file,) = list(eval_dir.rglob("results.json"))
+    (ckpt_results,) = json.load(open(results_file)).values()
+    assert len(ckpt_results["val_accuracies"]) == 2
+    assert all(0.0 <= a <= 1.0 for a in ckpt_results["val_accuracies"])
+
+    feat_dir = tmp_path / "features"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "-m", "simclr_tpu.save_features",
+            "experiment.batches=8",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.target_dir={save_dir}",
+            f"experiment.save_dir={feat_dir}",
+        ],
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    names = {p.name for p in feat_dir.rglob("*.npy")}
+    key = "epoch=1-cifar10"
+    for expected in (
+        f"{key}.train.features.npy",
+        f"{key}.train.labels.npy",
+        f"{key}.val.features.npy",
+        f"{key}.val.labels.npy",
+        f"{key}.train.aug-1.features.npy",
+        f"{key}.train.aug-5.features.npy",
+        f"{key}.train.aug-20.features.npy",
+    ):
+        assert expected in names, (expected, names)
+
+
 def test_two_process_epoch_compile(tmp_path):
     """runtime.epoch_compile under 2 real processes: the replicated dataset
     upload (mesh.put_replicated) must place onto devices this process cannot
@@ -122,7 +208,6 @@ def test_two_process_epoch_compile(tmp_path):
         [
             "--nprocs", "2",
             "--devices-per-proc", "2",
-            "--coordinator", "127.0.0.1:13381",
             "-m", "simclr_tpu.main",
             "runtime.epoch_compile=true",
             "parameter.epochs=1",
@@ -150,7 +235,6 @@ def test_two_process_tp_pretrain(tmp_path):
         [
             "--nprocs", "2",
             "--devices-per-proc", "2",
-            "--coordinator", "127.0.0.1:13401",
             "-m", "simclr_tpu.main",
             "mesh.model=2",
             "parameter.epochs=1",
@@ -176,7 +260,6 @@ def test_two_process_supervised_epoch_compile(tmp_path):
         [
             "--nprocs", "2",
             "--devices-per-proc", "2",
-            "--coordinator", "127.0.0.1:13391",
             "-m", "simclr_tpu.supervised",
             "runtime.epoch_compile=true",
             "parameter.epochs=1",
@@ -205,9 +288,9 @@ def test_fail_fast_on_child_killed_mid_run(tmp_path):
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "simclr_tpu.launch",
+                "--coordinator", _coordinator(),
                 "--nprocs", "2",
                 "--devices-per-proc", "1",
-                "--coordinator", "127.0.0.1:13361",
                 "-m", "simclr_tpu.main",
                 "parameter.epochs=500",  # long enough to still be running
                 "experiment.batches=8",
@@ -260,7 +343,6 @@ def test_fail_fast_on_child_failure():
         [
             "--nprocs", "2",
             "--devices-per-proc", "1",
-            "--coordinator", "127.0.0.1:13341",
             "-m", "simclr_tpu.main",
             "parameter.epochs=not_an_int",  # config validation fails in children
         ],
@@ -300,7 +382,6 @@ def test_proc_id_mode_runs_module_in_process(tmp_path):
         [
             "--nprocs", "1",
             "--proc-id", "0",
-            "--coordinator", "127.0.0.1:13351",
             "--devices-per-proc", "2",
             "-m", "simclr_tpu.main",
             "parameter.epochs=1",

@@ -178,7 +178,6 @@ def run_pretrain(cfg: Config) -> dict:
 
         unsupported = {
             "loss.fused": step_kwargs["fused"],
-            "model.remat": step_kwargs["remat"],
             "loss.negatives != global": step_kwargs["negatives"] != "global",
             "model.forward_mode != two_pass": step_kwargs["forward_mode"] != "two_pass",
         }
@@ -197,6 +196,7 @@ def run_pretrain(cfg: Config) -> dict:
                 model, tx, mesh,
                 temperature=step_kwargs["temperature"],
                 strength=step_kwargs["strength"],
+                remat=step_kwargs["remat"],
             )
             images_all = put_replicated(dataset.images, mesh)
             iterator = None
@@ -205,6 +205,7 @@ def run_pretrain(cfg: Config) -> dict:
                 model, tx, mesh,
                 temperature=step_kwargs["temperature"],
                 strength=step_kwargs["strength"],
+                remat=step_kwargs["remat"],
             )
             iterator = EpochIterator(
                 dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,

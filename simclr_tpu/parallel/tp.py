@@ -49,7 +49,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from simclr_tpu.models.resnet import feature_dim
 from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
 from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
-from simclr_tpu.parallel.steps import _augment_two_views
+from simclr_tpu.parallel.steps import _augment_two_views, _forward_fn
 from simclr_tpu.parallel.train_state import TrainState
 
 
@@ -122,13 +122,16 @@ def _make_step_body(
     temperature: float,
     strength: float,
     out_size: int,
+    remat: bool = False,
 ):
     """The un-jitted TP step: shard_map'ed forward/backward + jit-level
     optimizer update. Shared by the dispatch-per-step and epoch-compiled
     paths so their numerics can never diverge (same pattern as
-    ``steps._make_local_pretrain_step``)."""
+    ``steps._make_local_pretrain_step``). ``remat`` rematerializes the
+    forward during backward exactly like ``steps._forward_fn``."""
     tp = mesh.shape[MODEL_AXIS]
     local_model = _local_view(model, tp)
+    fwd = _forward_fn(local_model, remat)  # the dp step's forward/remat recipe
 
     def local_fwd_bwd(params, batch_stats, images, rng):
         # the dp step's exact augmentation recipe (steps.py): keys depend on
@@ -137,14 +140,8 @@ def _make_step_body(
         v0, v1 = _augment_two_views(rng, images, strength, out_size)
 
         def loss_fn(p):
-            z0, mut = local_model.apply(
-                {"params": p, "batch_stats": batch_stats}, v0, train=True,
-                mutable=["batch_stats"],
-            )
-            z1, mut = local_model.apply(
-                {"params": p, "batch_stats": mut["batch_stats"]}, v1, train=True,
-                mutable=["batch_stats"],
-            )
+            z0, mut = fwd(p, batch_stats, v0)
+            z1, mut = fwd(p, mut["batch_stats"], v1)
             loss = ntxent_loss_sharded_rows(z0, z1, DATA_AXIS, temperature)
             return loss, mut["batch_stats"]
 
@@ -190,6 +187,7 @@ def make_pretrain_step_tp(
     temperature: float = 0.5,
     strength: float = 0.5,
     out_size: int = 32,
+    remat: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Contrastive train step with the projection head tensor-parallel over
     the ``model`` mesh axis (global NT-Xent negatives over ``data``).
@@ -202,6 +200,7 @@ def make_pretrain_step_tp(
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
+        remat=remat,
     )
     return jax.jit(step, donate_argnums=(0,))
 
@@ -214,6 +213,7 @@ def make_pretrain_epoch_fn_tp(
     temperature: float = 0.5,
     strength: float = 0.5,
     out_size: int = 32,
+    remat: bool = False,
 ) -> Callable[..., tuple[TrainState, dict]]:
     """Epoch-compiled TP training: ``lax.scan`` over steps at the JIT level.
 
@@ -232,6 +232,7 @@ def make_pretrain_epoch_fn_tp(
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
+        remat=remat,
     )
     batched = NamedSharding(mesh, P(DATA_AXIS))
 

@@ -284,6 +284,44 @@ def test_tp_epoch_compile_matches_per_step():
 
 
 @pytest.mark.slow
+def test_tp_remat_matches_non_remat():
+    """model.remat under TP: jax.checkpoint recomputes the forward in the
+    backward pass but must not change the math — one step, same state/batch/
+    rng, losses and updated head shards agree to float tolerance."""
+    mesh = create_mesh(MeshSpec(data=2, model=4))
+    model = ContrastiveModel(
+        base_cnn="resnet18", d=128, dtype=jnp.float32,
+        bn_cross_replica_axis=DATA_AXIS,
+    )
+    tx = lars(warmup_cosine_schedule(0.1, 20, 2), weight_decay=1e-4,
+              weight_decay_mask=simclr_weight_decay_mask)
+
+    def fresh_state():
+        s = create_train_state(
+            model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
+        )
+        return jax.device_put(s, tp_state_shardings(mesh, s))
+
+    images = np.random.default_rng(3).integers(
+        0, 256, size=(8, 32, 32, 3), dtype=np.uint8
+    )
+    batch = jax.device_put(images, batch_sharding(mesh))
+    rng = jax.random.key(9)
+
+    outs = {}
+    for remat in (False, True):
+        step = make_pretrain_step_tp(model, tx, mesh, remat=remat)
+        state, m = step(fresh_state(), batch, rng)
+        outs[remat] = (float(m["loss"]), jax.device_get(state.params))
+
+    assert outs[False][0] == pytest.approx(outs[True][0], rel=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+        outs[False][1], outs[True][1],
+    )
+
+
+@pytest.mark.slow
 def test_tp_epoch_compile_entrypoint(tmp_path):
     """mesh.model=2 + runtime.epoch_compile=true end to end through main."""
     from simclr_tpu.main import main as pretrain_main

@@ -1,0 +1,131 @@
+"""Export Flax variables as reference-compatible PyTorch state dicts.
+
+The inverse of :mod:`simclr_tpu.utils.torch_import`: checkpoints trained in
+this framework become ``.pt`` state dicts the reference's own tooling
+consumes directly (``torch.load`` + ``load_state_dict`` in
+``/root/reference/eval.py:256-263`` / ``save_features.py:146-149``), so a
+reference user can migrate in either direction — pretrain here, probe
+there, or vice versa.
+
+Key mapping is the import shim's, inverted (see torch_import's table);
+conv kernels go HWIO->OIHW, linear kernels transpose back to (out, in).
+``num_batches_tracked`` — present in every torch BN state dict but never
+read by the reference's load path — is emitted as 0 so ``strict=True``
+loads succeed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from simclr_tpu.models.arch import (
+    BLOCK_NAME as _BLOCK_NAME,
+    CONVS_PER_BLOCK as _CONVS_PER_BLOCK,
+    STAGE_SIZES as _STAGE_SIZES,
+)
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def _conv_out(w) -> np.ndarray:
+    """flax HWIO -> torch OIHW."""
+    return _np(w).transpose(3, 2, 0, 1)
+
+
+def _linear_out(w) -> np.ndarray:
+    """flax (in, out) -> torch (out, in)."""
+    return _np(w).T
+
+
+def _export_bn(sd: dict, torch_key: str, p_node: Mapping, s_node: Mapping) -> None:
+    sd[f"{torch_key}.weight"] = _np(p_node["scale"])
+    sd[f"{torch_key}.bias"] = _np(p_node["bias"])
+    sd[f"{torch_key}.running_mean"] = _np(s_node["mean"])
+    sd[f"{torch_key}.running_var"] = _np(s_node["var"])
+    sd[f"{torch_key}.num_batches_tracked"] = np.asarray(0, dtype=np.int64)
+
+
+def _export_encoder(
+    sd: dict, params: Mapping, stats: Mapping, base_cnn: str, torch_prefix: str = "f."
+) -> None:
+    block_name = _BLOCK_NAME[base_cnn]
+    n_convs = _CONVS_PER_BLOCK[base_cnn]
+    f_p, f_s = params["f"], stats["f"]
+
+    sd[f"{torch_prefix}conv1.weight"] = _conv_out(f_p["stem_conv"]["kernel"])
+    _export_bn(sd, f"{torch_prefix}bn1", f_p["BatchNorm_0"], f_s["BatchNorm_0"])
+
+    block_idx = 0
+    for stage, num_blocks in enumerate(_STAGE_SIZES[base_cnn], start=1):
+        for b in range(num_blocks):
+            tp = f"{torch_prefix}layer{stage}.{b}."
+            bp, bs = f_p[f"{block_name}_{block_idx}"], f_s[f"{block_name}_{block_idx}"]
+            for c in range(n_convs):
+                sd[f"{tp}conv{c + 1}.weight"] = _conv_out(bp[f"Conv_{c}"]["kernel"])
+                _export_bn(sd, f"{tp}bn{c + 1}", bp[f"BatchNorm_{c}"], bs[f"BatchNorm_{c}"])
+            if f"Conv_{n_convs}" in bp:  # projection shortcut (torch downsample)
+                sd[f"{tp}downsample.0.weight"] = _conv_out(bp[f"Conv_{n_convs}"]["kernel"])
+                _export_bn(
+                    sd, f"{tp}downsample.1", bp[f"BatchNorm_{n_convs}"], bs[f"BatchNorm_{n_convs}"]
+                )
+            block_idx += 1
+
+
+def export_contrastive_state_dict(
+    variables: Mapping[str, Any], base_cnn: str = "resnet18", ddp_prefix: bool = False
+) -> dict[str, np.ndarray]:
+    """``{params, batch_stats}`` -> reference ``ContrastiveModel`` state dict.
+
+    ``ddp_prefix=True`` prepends ``module.`` to every key, mimicking the
+    reference's DDP-wrapped saves (its eval strips the prefix anyway).
+    """
+    params, stats = variables["params"], variables["batch_stats"]
+    sd: dict[str, np.ndarray] = {}
+    _export_encoder(sd, params, stats, base_cnn)
+    g_p, g_s = params["g"], stats["g"]
+    sd["g.projection_head.0.weight"] = _linear_out(g_p["linear1"]["kernel"])
+    sd["g.projection_head.0.bias"] = _np(g_p["linear1"]["bias"])
+    _export_bn(sd, "g.projection_head.1", g_p["bn1"], g_s["bn1"])
+    sd["g.projection_head.3.weight"] = _linear_out(g_p["linear2"]["kernel"])
+    if ddp_prefix:
+        sd = {f"module.{k}": v for k, v in sd.items()}
+    return sd
+
+
+def export_supervised_state_dict(
+    variables: Mapping[str, Any], base_cnn: str = "resnet18", ddp_prefix: bool = False
+) -> dict[str, np.ndarray]:
+    """``{params, batch_stats}`` -> reference ``SupervisedModel`` state dict."""
+    params, stats = variables["params"], variables["batch_stats"]
+    sd: dict[str, np.ndarray] = {}
+    _export_encoder(sd, params, stats, base_cnn)
+    sd["fc.weight"] = _linear_out(params["fc"]["kernel"])
+    sd["fc.bias"] = _np(params["fc"]["bias"])
+    if ddp_prefix:
+        sd = {f"module.{k}": v for k, v in sd.items()}
+    return sd
+
+
+def save_torch_checkpoint(
+    path: str,
+    variables: Mapping[str, Any],
+    base_cnn: str = "resnet18",
+    kind: str = "contrastive",
+    ddp_prefix: bool = False,
+) -> None:
+    """Write a ``.pt`` the reference's ``torch.load`` consumes (needs torch)."""
+    import torch
+
+    if kind == "contrastive":
+        sd = export_contrastive_state_dict(variables, base_cnn, ddp_prefix)
+    elif kind == "supervised":
+        sd = export_supervised_state_dict(variables, base_cnn, ddp_prefix)
+    else:
+        raise ValueError(f"kind must be contrastive|supervised, got {kind!r}")
+    # copy=True: exported arrays can be read-only jax buffers, and torch
+    # refuses (warns on) non-writable storage
+    torch.save({k: torch.from_numpy(np.array(v, copy=True)) for k, v in sd.items()}, path)

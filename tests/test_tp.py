@@ -76,9 +76,9 @@ def test_sharded_head_forward_matches_unsharded():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
-def _run_steps(mesh, n_steps=2, per_device_batch=4):
+def _run_steps(mesh, n_steps=2, per_device_batch=4, dtype=jnp.float32):
     model = ContrastiveModel(
-        base_cnn="resnet18", d=128, dtype=jnp.float32,
+        base_cnn="resnet18", d=128, dtype=dtype,
         bn_cross_replica_axis=DATA_AXIS,
     )
     tx = lars(
@@ -281,6 +281,72 @@ def test_tp_epoch_compile_matches_per_step():
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(flat_a[key]), atol=2e-5, err_msg=key
         )
+
+
+@pytest.mark.slow
+def test_tp_matches_degenerate_in_bf16():
+    """bf16 dp-vs-tp sanity: whole-step losses track between a (2,4) and a
+    (2,1) mesh with dtype=bfloat16. Coarse by nature (bf16 reorderings) —
+    the f32-upcast invariant itself is pinned by the cancellation test
+    below, not by this tolerance."""
+    devices = jax.devices()
+    mesh_tp = create_mesh(MeshSpec(data=2, model=4), devices=devices)
+    mesh_dp = create_mesh(MeshSpec(data=2, model=1), devices=devices[:2])
+
+    losses_tp, _ = _run_steps(mesh_tp, dtype=jnp.bfloat16)
+    losses_dp, _ = _run_steps(mesh_dp, dtype=jnp.bfloat16)
+    np.testing.assert_allclose(losses_tp, losses_dp, rtol=1e-2)
+
+
+def test_tp_output_psum_operand_is_f32():
+    """Trace-level pin of the f32 upcast before the row-parallel output
+    psum (ADVICE r2; heads.py). A NUMERICAL cpu test cannot see the
+    deviation — XLA's CPU all-reduce accumulates bf16 operands in f32
+    internally (verified: bf16 psum of [1024, 1, -1024, 1] returns exactly
+    2) — but on TPU ICI the all-reduce accumulation precision follows the
+    operand dtype, which is exactly why the head casts up first. So pin
+    the jaxpr: with a bfloat16 head, every psum the TP forward emits must
+    take float32 operands."""
+    tp = 4
+    mesh = create_mesh(MeshSpec(data=1, model=tp), devices=jax.devices()[:tp])
+    head = ProjectionHead(d=128, dtype=jnp.bfloat16)
+    h = jnp.ones((2, 512), jnp.float32)
+    variables = head.init(jax.random.key(0), h, train=True)
+
+    local = ProjectionHead(d=128, dtype=jnp.bfloat16, hidden=512 // tp,
+                           tp_axis=MODEL_AXIS)
+    p_specs = tree_pspecs({"g": variables["params"]})["g"]
+    s_specs = tree_pspecs({"g": variables["batch_stats"]})["g"]
+
+    def fwd(p, s, x):
+        return local.apply({"params": p, "batch_stats": s}, x, train=False)
+
+    sharded = jax.shard_map(
+        fwd, mesh=mesh, in_specs=(p_specs, s_specs, P()), out_specs=P(),
+        check_vma=False,
+    )
+    jaxpr = jax.make_jaxpr(sharded)(
+        variables["params"], variables["batch_stats"], h
+    )
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                    inner = getattr(sub, "jaxpr", sub)
+                    if hasattr(inner, "eqns"):
+                        yield from walk(inner)
+
+    psum_in_dtypes = [
+        v.aval.dtype
+        for eqn in walk(jaxpr.jaxpr)
+        if "psum" in eqn.primitive.name
+        for v in eqn.invars
+        if hasattr(v.aval, "dtype")
+    ]
+    assert psum_in_dtypes, "no psum found in the TP head forward"
+    assert all(dt == jnp.float32 for dt in psum_in_dtypes), psum_in_dtypes
 
 
 @pytest.mark.slow

@@ -105,6 +105,35 @@ def test_supervised_round_trip():
             np.testing.assert_array_equal(exported[k], v, err_msg=k)
 
 
+@pytest.mark.slow
+def test_export_cli_round_trip(tmp_path):
+    """python -m simclr_tpu.export_torch over a real pretrain checkpoint
+    dir: the written .pt strict-loads into the reference-shaped torch
+    model."""
+    from simclr_tpu.export_torch import main as export_main
+    from simclr_tpu.main import main as pretrain_main
+
+    save_dir = str(tmp_path / "ckpts")
+    pretrain_main(
+        [
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=32",
+            "experiment.batches=4",
+            "parameter.epochs=1",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            f"experiment.save_dir={save_dir}",
+        ]
+    )
+    out_dir = str(tmp_path / "pt")
+    written = export_main(["--target-dir", save_dir, "--out-dir", out_dir])
+    assert len(written) == 1 and written[0].endswith("epoch=1-cifar10.pt")
+
+    sd = torch.load(written[0], map_location="cpu", weights_only=True)
+    tmodel = _TorchContrastive()
+    tmodel.load_state_dict(sd, strict=True)
+
+
 def test_resnet50_key_layout():
     """Exported resnet50 init produces exactly the torchvision bottleneck
     key set, including every stage's first-block downsample pair."""

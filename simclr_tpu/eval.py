@@ -52,7 +52,7 @@ from simclr_tpu.parallel.mesh import (
     validate_per_device_batch,
 )
 from simclr_tpu.parallel.steps import make_encode_step
-from simclr_tpu.utils.checkpoint import list_checkpoints, restore_checkpoint
+from simclr_tpu.utils.checkpoint import list_checkpoints_or_raise, restore_checkpoint
 from simclr_tpu.utils.logging import get_logger, is_logging_host
 from simclr_tpu.utils.schedule import calculate_initial_lr
 
@@ -150,6 +150,7 @@ def _probe_program(
     decay: float,
     momentum: float,
     total_steps: int,
+    mesh=None,
 ):
     """(classifier, optimizer, jitted scan-of-scans probe program).
 
@@ -157,6 +158,13 @@ def _probe_program(
     one run compiles the (large) probe program ONCE and reuses the
     executable — a fresh ``@jax.jit`` closure per checkpoint would re-trace
     and re-compile every time.
+
+    With ``mesh`` (hashable) the per-epoch full-dataset metric sweeps — the
+    probe run's dominant FLOPs, two dataset-sized matmuls per epoch — are
+    sharded over the data axis via sharding constraints (GSPMD splits the
+    matmul and psums the scalar sums back), instead of every device
+    repeating identical work. The tiny sequential SGD steps stay replicated:
+    they gather arbitrary shuffled rows and wouldn't amortize collectives.
     """
     steps_per_epoch = math.ceil(n / batch)
     schedule = optax.cosine_decay_schedule(lr0, decay_steps=total_steps)
@@ -228,7 +236,18 @@ def _probe_program(
         params = optax.apply_updates(params, updates)
         return params, opt_state, new_stats, loss
 
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from simclr_tpu.parallel.mesh import DATA_AXIS
+
+        _rows = NamedSharding(mesh, P(DATA_AXIS))
+        _rep = NamedSharding(mesh, P())
+
     def dataset_metrics(params, batch_stats, Xs, ys):
+        if mesh is not None:
+            Xs = jax.lax.with_sharding_constraint(Xs, _rows)
+            ys = jax.lax.with_sharding_constraint(ys, _rows)
         if has_bn:
             logits = _mlp_eval_forward(params, batch_stats, Xs)
         else:
@@ -239,9 +258,21 @@ def _probe_program(
         return top1.astype(jnp.float32), topk.astype(jnp.float32), loss_sum
 
     @jax.jit
-    def run_probe(params, opt_state, batch_stats, idx_all, X, y, Xv, yv):
+    def run_probe(params, opt_state, batch_stats, idx_all, X, y, Xsw, ysw, Xv, yv):
         # features enter as jit ARGUMENTS, not closure constants, so they
-        # are neither baked into the program nor duplicated per checkpoint
+        # are neither baked into the program nor duplicated per checkpoint.
+        # The train matrix enters TWICE on purpose (X/y for the SGD path,
+        # Xsw/ysw for the sweeps): GSPMD propagates dataset_metrics' row
+        # constraint backward to whichever loop-invariant input the sweep
+        # reads, and if the SGD path shared that input, every sequential
+        # step's batch gather would compile into a cross-device gather +
+        # all-reduce (observed in HLO). Distinct arguments give each use
+        # its own sharding; the sharded duplicate costs 1/n_devices extra
+        # memory per device.
+        if mesh is not None:
+            X = jax.lax.with_sharding_constraint(X, _rep)
+            y = jax.lax.with_sharding_constraint(y, _rep)
+
         def step_body(carry, st):
             p, o, s = carry
             i, mk = st
@@ -253,7 +284,7 @@ def _probe_program(
                 step_body, carry, (idx_e, jnp.asarray(mask_epoch))
             )
             p, o, s = carry
-            tr = dataset_metrics(p, s, X, y)
+            tr = dataset_metrics(p, s, Xsw, ysw)
             va = dataset_metrics(p, s, Xv, yv)
             return carry, (losses.sum(), tr, va)
 
@@ -271,6 +302,7 @@ def learnable_probe(
     val_y: np.ndarray,
     num_classes: int,
     top_k: int,
+    mesh=None,
 ) -> dict:
     """Train a linear/nonlinear probe, reference-exact recipe.
 
@@ -307,6 +339,7 @@ def learnable_probe(
         float(cfg.experiment.decay),
         float(cfg.parameter.momentum),
         max(total_steps, 1),
+        mesh,
     )
     variables = clf.init(jax.random.key(seed), jnp.zeros((2, train_X.shape[1])))
     params = variables["params"]
@@ -329,7 +362,7 @@ def learnable_probe(
     idx_all = jnp.asarray(idx_np.reshape(epochs, steps_per_epoch, batch))
 
     (params, opt_state, batch_stats), (epoch_losses, tr_hist, va_hist) = run_probe(
-        params, opt_state, batch_stats, idx_all, X, y, Xv, yv
+        params, opt_state, batch_stats, idx_all, X, y, X, y, Xv, yv
     )
     epoch_losses = np.asarray(epoch_losses)
     tr1, trk, trl = (np.asarray(a) for a in tr_hist)
@@ -388,11 +421,7 @@ def run_eval(cfg: Config) -> dict:
     batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
     classifier_kind = str(cfg.parameter.classifier)
 
-    checkpoints = list_checkpoints(str(cfg.experiment.target_dir))
-    if not checkpoints:
-        raise FileNotFoundError(
-            f"no checkpoints found under {cfg.experiment.target_dir!r}"
-        )
+    checkpoints = list_checkpoints_or_raise(str(cfg.experiment.target_dir))
 
     classification_results = {}
     for ckpt in checkpoints:
@@ -416,7 +445,7 @@ def run_eval(cfg: Config) -> dict:
         else:
             results = learnable_probe(
                 cfg, classifier_kind, train_X, train_ds.labels, val_X, val_ds.labels,
-                num_classes, top_k,
+                num_classes, top_k, mesh=mesh,
             )
             logger.info(
                 "train acc: %s, val acc: %s",

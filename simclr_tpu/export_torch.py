@@ -20,7 +20,7 @@ from __future__ import annotations
 import argparse
 import os
 
-from simclr_tpu.utils.checkpoint import list_checkpoints
+from simclr_tpu.utils.checkpoint import list_checkpoints_or_raise
 from simclr_tpu.utils.torch_export import save_torch_checkpoint
 
 
@@ -44,9 +44,7 @@ def main(argv: list[str] | None = None) -> list[str]:
 
     from simclr_tpu.eval import load_model_variables
 
-    checkpoints = list_checkpoints(args.target_dir)
-    if not checkpoints:
-        raise FileNotFoundError(f"no checkpoints under {args.target_dir!r}")
+    checkpoints = list_checkpoints_or_raise(args.target_dir)
     os.makedirs(args.out_dir, exist_ok=True)
     written = []
     for ckpt in checkpoints:

@@ -35,7 +35,7 @@ from simclr_tpu.parallel.mesh import (
     validate_per_device_batch,
 )
 from simclr_tpu.parallel.steps import make_augmented_encode_step
-from simclr_tpu.utils.checkpoint import list_checkpoints
+from simclr_tpu.utils.checkpoint import list_checkpoints_or_raise
 from simclr_tpu.utils.logging import get_logger, is_logging_host
 
 logger = get_logger()
@@ -116,11 +116,7 @@ def run_save_features(cfg: Config) -> list[str]:
             np.save(path, array)
         written.append(path)
 
-    checkpoints = list_checkpoints(str(cfg.experiment.target_dir))
-    if not checkpoints:
-        raise FileNotFoundError(
-            f"no checkpoints found under {cfg.experiment.target_dir!r}"
-        )
+    checkpoints = list_checkpoints_or_raise(str(cfg.experiment.target_dir))
 
     for ckpt in checkpoints:
         key = os.path.basename(ckpt)

@@ -56,6 +56,16 @@ def list_checkpoints(target_dir: str) -> list[str]:
     return sorted(out, key=epoch_of)
 
 
+def list_checkpoints_or_raise(target_dir: str) -> list[str]:
+    """:func:`list_checkpoints`, raising ``FileNotFoundError`` when empty —
+    the shared preflight of every checkpoint-consuming entry point
+    (eval / save_features / export_torch)."""
+    checkpoints = list_checkpoints(target_dir)
+    if not checkpoints:
+        raise FileNotFoundError(f"no checkpoints found under {target_dir!r}")
+    return checkpoints
+
+
 def save_checkpoint(path: str, state) -> None:
     """Save a pytree (TrainState or plain dict) to ``path`` atomically."""
     path = os.path.abspath(path)

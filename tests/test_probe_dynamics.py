@@ -229,6 +229,29 @@ def test_nonlinear_probe_dynamics_match_reference_recipe():
     )
 
 
+def test_sharded_metric_sweeps_match_replicated():
+    """learnable_probe(mesh=...) shards the per-epoch full-dataset sweeps
+    over the data axis (GSPMD-partitioned matmuls + summed metrics); the
+    training path is untouched, so params are identical and only the
+    metric-sum accumulation order may differ — accuracies must be exactly
+    equal, losses within float accumulation noise."""
+    from simclr_tpu.parallel.mesh import create_mesh
+
+    Xtr, ytr = _features(5, N_TRAIN)
+    Xva, yva = _features(6, N_VAL)
+    cfg = _probe_cfg()
+    for kind in ("linear", "nonlinear"):
+        a = learnable_probe(cfg, kind, Xtr, ytr, Xva, yva, NUM_CLASSES, TOP_K)
+        b = learnable_probe(
+            cfg, kind, Xtr, ytr, Xva, yva, NUM_CLASSES, TOP_K,
+            mesh=create_mesh(),
+        )
+        np.testing.assert_array_equal(a["val_accuracies"], b["val_accuracies"])
+        np.testing.assert_array_equal(a["train_accuracies"], b["train_accuracies"])
+        np.testing.assert_allclose(a["val_losses"], b["val_losses"], rtol=1e-6)
+        np.testing.assert_allclose(a["train_losses"], b["train_losses"], rtol=1e-6)
+
+
 def test_end_to_end_pretrain_probe_parity():
     """Full pipeline: 16 reference-recipe pretrain steps (torch eager vs our
     jitted step, same init/batches), frozen-feature extraction, then each

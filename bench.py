@@ -304,9 +304,12 @@ def worker(backend: str) -> None:
     ]
 
     def measure(step_kwargs):
-        """imgs/sec/chip of one step variant (shared sync discipline:
-        utils.profiling.time_step_loop — the window is long, ~6s of device
-        time, so queueing effects at the margin are amortized)."""
+        """(imgs/sec/chip, flops/step) of one step variant (shared sync
+        discipline: utils.profiling.time_step_loop — the window is long,
+        ~6s of device time, so queueing effects at the margin are
+        amortized). Explicit lower+compile gives XLA's cost analysis for
+        the exact executable being timed, so the payload can carry a
+        sustained-TFLOP/s (MFU numerator) figure."""
         state = create_train_state(
             model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
         )
@@ -315,11 +318,18 @@ def worker(backend: str) -> None:
             model, tx, mesh, temperature=0.5, strength=0.5, negatives="global",
             **step_kwargs,
         )
+        compiled = step.lower(state, batches[0], jax.random.key(0)).compile()
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = float(ca.get("flops", 0.0))
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+            flops = 0.0
         dt, final_loss, _ = time_step_loop(
-            step, state, batches, jax.random.key(0), warmup_steps, timed_steps
+            compiled, state, batches, jax.random.key(0), warmup_steps, timed_steps
         )
         assert np.isfinite(final_loss)
-        return timed_steps * global_batch / dt / n_chips
+        return timed_steps * global_batch / dt / n_chips, flops
 
     # On TPU, measure the step variants and report the fastest ELIGIBLE one
     # — the variant exploration happens wherever the hardware is actually
@@ -336,7 +346,7 @@ def worker(backend: str) -> None:
         variants["two_pass_fused"] = {"fused": True}
         variants["concat"] = {"forward_mode": "concat"}
 
-    def emit(rates, errors):
+    def emit(rates, flops_per_step, errors):
         """Best-so-far payload line. Printed after EVERY variant so a later
         variant that hangs (burning the subprocess timeout) cannot lose the
         measurements already taken — the orchestrator parses the last
@@ -361,18 +371,30 @@ def worker(backend: str) -> None:
             "baseline_note": "denominator 4000 imgs/sec is an estimated "
             "V100 rate; reference publishes no throughput (SURVEY §6)",
         }
+        flops = flops_per_step.get(best_name, 0.0)
+        if flops:
+            # Compiled.cost_analysis() reports the GSPMD-partitioned
+            # PER-DEVICE program's flops, so per-chip FLOP/s is simply
+            # flops * steps/s — no further n_chips division. Divide by the
+            # chip's peak for MFU (docs/PERF.md).
+            steps_per_sec = per_chip * n_chips / global_batch
+            payload["tflop_per_step_per_chip"] = round(flops / 1e12, 3)
+            payload["tflops_per_sec_per_chip"] = round(
+                flops * steps_per_sec / 1e12, 2
+            )
         if errors:
             payload["variant_errors"] = errors
         print(json.dumps(payload), flush=True)
 
-    rates, errors = {}, {}
+    rates, flops_per_step, errors = {}, {}, {}
     for name, kwargs in variants.items():
         try:
-            rates[name] = round(measure(kwargs), 1)
+            rates[name], flops_per_step[name] = measure(kwargs)
+            rates[name] = round(rates[name], 1)
         except Exception as exc:  # noqa: BLE001 — record and continue
             errors[name] = repr(exc)[:200]
         if rates:
-            emit(rates, errors)
+            emit(rates, flops_per_step, errors)
     if not rates:
         raise RuntimeError(f"every variant failed: {errors}")
 

@@ -107,8 +107,10 @@ def extract_features(
     outs = []
     for i in range(steps):
         chunk = put_global_batch(images[i * batch : (i + 1) * batch][local], sharding)
-        outs.append(_fetch(encode(variables["params"], variables["batch_stats"], chunk)))
-    return np.concatenate(outs)[:n]
+        # dispatch only — async dispatch pipelines upload/compute across
+        # chunks; the device->host sync happens once below
+        outs.append(encode(variables["params"], variables["batch_stats"], chunk))
+    return np.concatenate([_fetch(o) for o in outs])[:n]
 
 
 def _topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, top_k: int):

@@ -73,10 +73,10 @@ def augmented_features(
         for i in range(steps):
             chunk = put_global_batch(padded[i * batch : (i + 1) * batch][local], sharding)
             rng = jax.random.fold_in(jax.random.key(seed), t * steps + i)
-            feats.append(
-                _fetch(encode(variables["params"], variables["batch_stats"], chunk, rng))
-            )
-        pass_feats = np.concatenate(feats)[:n]
+            # dispatch only; the device->host sync happens once per pass so
+            # upload/compute pipeline across chunks (see eval.extract_features)
+            feats.append(encode(variables["params"], variables["batch_stats"], chunk, rng))
+        pass_feats = np.concatenate([_fetch(f) for f in feats])[:n]
         mean = pass_feats if mean is None else mean + (pass_feats - mean) / t
         if t in snapshots:
             out[t] = mean.copy()

@@ -241,6 +241,54 @@ def run_pretrain(cfg: Config) -> dict:
     base_key = jax.random.key(seed + 1)
     metrics = {"loss": jnp.zeros(())}
     save_model_epoch = int(cfg.experiment.save_model_epoch)
+    # experiment.eval_every > 0: centroid-probe the test split every N
+    # epochs — a REAL monitor where the reference's validation() is an
+    # empty stub (/root/reference/main.py:53-58, SURVEY §2.5.6). Off by
+    # default for recipe parity.
+    eval_every = int(cfg.select("experiment.eval_every", 0) or 0)
+    monitor_val_acc = None
+    if eval_every > 0:
+        test_ds = load_dataset(
+            cfg.experiment.name, "test",
+            data_dir=cfg.select("experiment.data_dir"),
+            synthetic_ok=bool(cfg.select("experiment.synthetic_data", False)),
+            synthetic_size=cfg.select("experiment.synthetic_size"),
+        )
+        # on-device reshard to replicated: the encode program expects
+        # replicated variables, and a TP run's live head leaves are
+        # model-sharded global arrays that span non-addressable devices
+        # under multi-process (a bare np.asarray would raise). The jitted
+        # identity's out_shardings makes XLA do the all-gather; the
+        # fully-replicated outputs are then host-fetchable everywhere.
+        gather_replicated = jax.jit(
+            lambda t: t, out_shardings=replicated_sharding(mesh)
+        )
+
+    def run_monitor_probe(epoch: int) -> float:
+        from simclr_tpu.eval import centroid_probe, extract_features
+
+        variables = jax.tree.map(
+            np.asarray,
+            gather_replicated(
+                {"params": state.params, "batch_stats": state.batch_stats}
+            ),
+        )
+        train_X = extract_features(
+            model, variables, dataset.images, mesh, global_batch, False
+        )
+        val_X = extract_features(
+            model, variables, test_ds.images, mesh, global_batch, False
+        )
+        res = centroid_probe(
+            train_X, dataset.labels, val_X, test_ds.labels,
+            dataset.num_classes, top_k=5,
+        )
+        if is_logging_host():
+            logger.info(
+                "Epoch:%d centroid probe: val top-1 %.4f (top-5 %.4f)",
+                epoch, res["val_acc"], res["val_top_5_acc"],
+            )
+        return res["val_acc"]
     # host-side step counter: reading state.step off-device every iteration
     # would sync the host to the in-flight step and kill async dispatch
     cur_step = (start_epoch - 1) * steps_per_epoch
@@ -289,6 +337,10 @@ def run_pretrain(cfg: Config) -> dict:
                 epoch, epochs, epoch / epochs, float(metrics["loss"]), lr_now,
                 imgs_per_sec,
             )
+        if eval_every > 0 and (epoch % eval_every == 0 or epoch == epochs):
+            timer.pause(metrics["loss"])  # keep probe compute out of imgs/sec
+            monitor_val_acc = run_monitor_probe(epoch)
+            timer.resume()
         if epoch % save_model_epoch == 0 or epoch == epochs:
             path = os.path.join(
                 save_dir, checkpoint_name(epoch, str(cfg.experiment.output_model_name))
@@ -307,7 +359,7 @@ def run_pretrain(cfg: Config) -> dict:
             throughput["imgs_per_sec"], throughput["imgs_per_sec_per_chip"],
             timed_steps,
         )
-    return {
+    summary = {
         "final_loss": float(metrics["loss"]),
         "steps": int(state.step),
         "epochs": epochs,
@@ -317,6 +369,9 @@ def run_pretrain(cfg: Config) -> dict:
         "lr0": lr0,
         "imgs_per_sec_steady": throughput["imgs_per_sec"],
     }
+    if monitor_val_acc is not None:
+        summary["monitor_val_acc"] = monitor_val_acc
+    return summary
 
 
 def main(argv: list[str] | None = None) -> dict:

@@ -30,6 +30,7 @@ both the gathered-negatives and local-negatives objectives.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Any, Callable
 
@@ -454,10 +455,16 @@ def make_supervised_eval_step(model, mesh) -> Callable[..., Metrics]:
     return jax.jit(sharded)
 
 
+@functools.lru_cache(maxsize=32)
 def make_encode_step(
     model, mesh, *, use_full_encoder: bool = False
 ) -> Callable[..., jax.Array]:
     """Jitted frozen-feature extraction, batch-sharded in and out.
+
+    Memoized on (model, mesh, flags) — linen Modules hash by value — so
+    callers that re-enter per checkpoint or per monitoring epoch (eval.py,
+    main.py's eval_every probe) reuse one traced program instead of
+    re-tracing a fresh jit closure every call.
 
     ``use_full_encoder=False`` returns encoder features h (``model.encode``,
     reference ``eval.py:47-50`` / ``model.py:116-123``); True returns
@@ -488,6 +495,7 @@ def make_encode_step(
     return encode
 
 
+@functools.lru_cache(maxsize=32)
 def make_augmented_encode_step(
     model, mesh, *, strength: float = 0.5, out_size: int = 32,
     use_full_encoder: bool = False,

@@ -257,38 +257,44 @@ def run_pretrain(cfg: Config) -> dict:
         # on-device reshard to replicated: the encode program expects
         # replicated variables, and a TP run's live head leaves are
         # model-sharded global arrays that span non-addressable devices
-        # under multi-process (a bare np.asarray would raise). The jitted
+        # under multi-process (a bare host fetch would raise). The jitted
         # identity's out_shardings makes XLA do the all-gather; the
-        # fully-replicated outputs are then host-fetchable everywhere.
+        # replicated outputs feed the encode jit directly — no host round
+        # trip.
         gather_replicated = jax.jit(
             lambda t: t, out_shardings=replicated_sharding(mesh)
         )
+        # float32 extraction model, mirroring eval.py's — so the monitor's
+        # accuracy is directly comparable to a post-hoc eval.py centroid
+        # run on the same checkpoint regardless of the training compute
+        # dtype
+        monitor_model = ContrastiveModel(
+            base_cnn=cfg.experiment.base_cnn, d=int(cfg.parameter.d),
+            cifar_stem=True,
+        )
 
-    def run_monitor_probe(epoch: int) -> float:
-        from simclr_tpu.eval import centroid_probe, extract_features
+        def run_monitor_probe(epoch: int) -> float:
+            from simclr_tpu.eval import centroid_probe, extract_features
 
-        variables = jax.tree.map(
-            np.asarray,
-            gather_replicated(
+            variables = gather_replicated(
                 {"params": state.params, "batch_stats": state.batch_stats}
-            ),
-        )
-        train_X = extract_features(
-            model, variables, dataset.images, mesh, global_batch, False
-        )
-        val_X = extract_features(
-            model, variables, test_ds.images, mesh, global_batch, False
-        )
-        res = centroid_probe(
-            train_X, dataset.labels, val_X, test_ds.labels,
-            dataset.num_classes, top_k=5,
-        )
-        if is_logging_host():
-            logger.info(
-                "Epoch:%d centroid probe: val top-1 %.4f (top-5 %.4f)",
-                epoch, res["val_acc"], res["val_top_5_acc"],
             )
-        return res["val_acc"]
+            train_X = extract_features(
+                monitor_model, variables, dataset.images, mesh, global_batch, False
+            )
+            val_X = extract_features(
+                monitor_model, variables, test_ds.images, mesh, global_batch, False
+            )
+            res = centroid_probe(
+                train_X, dataset.labels, val_X, test_ds.labels,
+                dataset.num_classes, top_k=5,
+            )
+            if is_logging_host():
+                logger.info(
+                    "Epoch:%d centroid probe: val top-1 %.4f (top-5 %.4f)",
+                    epoch, res["val_acc"], res["val_top_5_acc"],
+                )
+            return res["val_acc"]
     # host-side step counter: reading state.step off-device every iteration
     # would sync the host to the in-flight step and kill async dispatch
     cur_step = (start_epoch - 1) * steps_per_epoch

@@ -59,6 +59,22 @@ from simclr_tpu.utils.schedule import calculate_initial_lr
 logger = get_logger()
 
 
+def build_eval_model(cfg: Config) -> ContrastiveModel:
+    """The frozen-feature extraction model, shared by eval, save_features,
+    and main's ``eval_every`` monitor so the three surfaces produce
+    numerically identical features for one checkpoint.
+
+    Explicit ``dtype=float32``: the TRAINING model computes in bfloat16 by
+    default, but extraction mirrors the reference's float32 torch forward
+    (``/root/reference/eval.py:31-58``) — probes see full-precision
+    features.
+    """
+    return ContrastiveModel(
+        base_cnn=cfg.experiment.base_cnn, d=int(cfg.parameter.d),
+        cifar_stem=True, dtype=jnp.float32,
+    )
+
+
 def load_model_variables(ckpt_path: str) -> dict:
     """Pull {params, batch_stats} out of a saved TrainState checkpoint.
 
@@ -413,9 +429,7 @@ def run_eval(cfg: Config) -> dict:
         synthetic_size=cfg.select("experiment.synthetic_size"),
     )
 
-    model = ContrastiveModel(
-        base_cnn=cfg.experiment.base_cnn, d=int(cfg.parameter.d), cifar_stem=True
-    )
+    model = build_eval_model(cfg)
     use_full_encoder = bool(cfg.parameter.use_full_encoder)
     # feature-extraction chunk: per-device batches x data shards so sharded
     # device_put tiles the mesh (probe training below uses the raw per-run

@@ -264,14 +264,12 @@ def run_pretrain(cfg: Config) -> dict:
         gather_replicated = jax.jit(
             lambda t: t, out_shardings=replicated_sharding(mesh)
         )
-        # float32 extraction model, mirroring eval.py's — so the monitor's
-        # accuracy is directly comparable to a post-hoc eval.py centroid
-        # run on the same checkpoint regardless of the training compute
-        # dtype
-        monitor_model = ContrastiveModel(
-            base_cnn=cfg.experiment.base_cnn, d=int(cfg.parameter.d),
-            cifar_stem=True,
-        )
+        # the shared f32 extraction model — the monitor's accuracy is
+        # directly comparable to a post-hoc eval.py centroid run on the
+        # same checkpoint regardless of the training compute dtype
+        from simclr_tpu.eval import build_eval_model
+
+        monitor_model = build_eval_model(cfg)
 
         def run_monitor_probe(epoch: int) -> float:
             from simclr_tpu.eval import centroid_probe, extract_features

@@ -25,8 +25,12 @@ import numpy as np
 
 from simclr_tpu.config import Config, check_save_features_conf, load_config, resolve_save_dir
 from simclr_tpu.data.cifar import load_dataset
-from simclr_tpu.eval import _fetch, extract_features, load_model_variables
-from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.eval import (
+    _fetch,
+    build_eval_model,
+    extract_features,
+    load_model_variables,
+)
 from simclr_tpu.parallel.mesh import (
     batch_sharding,
     mesh_from_config,
@@ -97,9 +101,7 @@ def run_save_features(cfg: Config) -> list[str]:
         synthetic_size=cfg.select("experiment.synthetic_size"),
     )
 
-    model = ContrastiveModel(
-        base_cnn=cfg.experiment.base_cnn, d=int(cfg.parameter.d), cifar_stem=True
-    )
+    model = build_eval_model(cfg)
     batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
     use_full_encoder = bool(cfg.parameter.use_full_encoder)
     strength = float(cfg.select("experiment.strength", 0.5))

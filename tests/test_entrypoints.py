@@ -96,6 +96,22 @@ class TestMonitor:
     def test_eval_every_off_by_default(self, pretrain_run):
         assert "monitor_val_acc" not in pretrain_run
 
+    def test_eval_every_with_epoch_compile(self, tmp_path):
+        """The monitor runs at the host level between epoch-scan programs,
+        so it must compose with runtime.epoch_compile."""
+        summary = pretrain_main(
+            SYNTH
+            + [
+                "runtime.epoch_compile=true",
+                "parameter.epochs=1",
+                "parameter.warmup_epochs=0",
+                "experiment.save_model_epoch=1",
+                "experiment.eval_every=1",
+                f"experiment.save_dir={tmp_path / 'mon-ec'}",
+            ]
+        )
+        assert 0.0 <= summary["monitor_val_acc"] <= 1.0
+
     def test_eval_every_under_tensor_parallelism(self, tmp_path):
         """The monitor's replicated gather must handle model-sharded head
         leaves (jitted identity with replicated out_shardings)."""

@@ -198,6 +198,31 @@ def test_two_process_linear_probe_and_save_features(tmp_path):
         assert expected in names, (expected, names)
 
 
+def test_two_process_pretrain_with_monitor(tmp_path):
+    """experiment.eval_every under 2 real processes: the monitor's
+    replicated gather (jitted identity over non-addressable shards) and the
+    multi-host feature extraction must both work mid-training."""
+    save_dir = tmp_path / "ckpts"
+    result = _run_launcher(
+        [
+            "--nprocs", "2",
+            "--devices-per-proc", "2",
+            "-m", "simclr_tpu.main",
+            "parameter.epochs=1",
+            "experiment.batches=8",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=1",
+            "experiment.eval_every=1",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            f"experiment.save_dir={save_dir}",
+        ],
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert result.stderr.count("centroid probe") == 1, result.stderr[-2000:]
+
+
 def test_two_process_epoch_compile(tmp_path):
     """runtime.epoch_compile under 2 real processes: the replicated dataset
     upload (mesh.put_replicated) must place onto devices this process cannot

@@ -6,7 +6,8 @@ mask (``ops/lars.py``), so adding an architecture or changing a depth is a
 one-file edit.
 
 The reference zoo is {resnet18, resnet50} (``/root/reference/model.py:87``);
-resnet34 (BasicBlock at resnet50's stage depths) is an addition.
+resnet34 (BasicBlock at resnet50's stage depths) and resnet101 (Bottleneck,
+23-block stage 3) are additions.
 """
 
 from __future__ import annotations
@@ -15,6 +16,7 @@ STAGE_SIZES: dict[str, tuple[int, int, int, int]] = {
     "resnet18": (2, 2, 2, 2),
     "resnet34": (3, 4, 6, 3),
     "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
 }
 STAGE_WIDTHS: tuple[int, int, int, int] = (64, 128, 256, 512)
 BASIC_BLOCK_CNNS: tuple[str, ...] = ("resnet18", "resnet34")

@@ -209,5 +209,6 @@ class ResNetEncoder(nn.Module):
 
 
 def feature_dim(base_cnn: str) -> int:
-    """Encoder output dimensionality (512 for resnet18/34, 2048 for resnet50)."""
+    """Encoder output dimensionality (512 for BasicBlock resnets, 2048 for
+    the Bottleneck ones — models/arch.py FEATURE_DIMS)."""
     return FEATURE_DIMS[base_cnn]

@@ -20,7 +20,8 @@ Key mapping (torchvision resnet18/34/50 + reference heads -> our Flax tree):
   g.projection_head.3.weight       g/linear2/kernel
   fc.{weight,bias}                 fc/{kernel,bias}            (SupervisedModel)
 
-where Block is BasicBlock (resnet18/34) or BottleneckBlock (resnet50) and ``i``
+where Block is BasicBlock (resnet18/34) or BottleneckBlock (resnet50/101)
+and ``i``
 counts blocks across stages in order. torch tensors are converted via
 numpy; torch itself is an optional dependency (only needed to unpickle
 ``.pt`` files — dict inputs work without it).

@@ -72,6 +72,19 @@ def test_resnet50_encoder_shapes_and_params(rng):
     assert n_params(variables["params"]) == RESNET50_ENCODER_PARAMS
 
 
+@pytest.mark.slow
+def test_resnet101_encoder_shapes_and_params(rng):
+    # torchvision resnet101 without fc: 42,500,160 params (total 44,549,160
+    # minus the 2048x1000+1000 fc); CIFAR stem swaps the 7x7 conv1 (9408
+    # params) for 3x3 (1728). Addition beyond the reference's {18,50} zoo.
+    enc = ResNetEncoder(base_cnn="resnet101", cifar_stem=True)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = enc.init(rng, x, train=False)
+    h = enc.apply(variables, x, train=False)
+    assert h.shape == (2, 2048)
+    assert n_params(variables["params"]) == 42_500_160 - 9408 + 1728
+
+
 def test_imagenet_stem_downsamples(rng):
     enc = ResNetEncoder(base_cnn="resnet18", cifar_stem=False)
     x = jnp.zeros((1, 64, 64, 3), jnp.float32)

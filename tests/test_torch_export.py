@@ -134,6 +134,31 @@ def test_export_cli_round_trip(tmp_path):
     tmodel.load_state_dict(sd, strict=True)
 
 
+@pytest.mark.slow
+def test_resnet101_layout_mask_and_round_trip():
+    """The zoo is table-driven (models/arch.py): resnet101's 23-block
+    stage 3 must flow through the export/import mappings and the
+    reference-exact weight-decay mask's structural count unchanged."""
+    from simclr_tpu.ops.lars import reference_weight_decay_mask
+
+    model = ContrastiveModel(base_cnn="resnet101", d=128, dtype=jnp.float32)
+    variables = jax.tree.map(
+        np.asarray, model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=True)
+    )
+    reference_weight_decay_mask(variables["params"], "resnet101")  # count assert
+    sd = export_contrastive_state_dict(variables, base_cnn="resnet101")
+    for stage, blocks in enumerate((3, 4, 23, 3), start=1):
+        for b in range(blocks):
+            assert f"f.layer{stage}.{b}.conv3.weight" in sd
+            assert (f"f.layer{stage}.{b}.downsample.0.weight" in sd) == (b == 0)
+    back = export_contrastive_state_dict(
+        import_contrastive_state_dict(sd, base_cnn="resnet101"), base_cnn="resnet101"
+    )
+    assert set(back) == set(sd)
+    for k, v in sd.items():
+        np.testing.assert_array_equal(back[k], v, err_msg=k)
+
+
 def test_resnet50_key_layout():
     """Exported resnet50 init produces exactly the torchvision bottleneck
     key set, including every stage's first-block downsample pair."""

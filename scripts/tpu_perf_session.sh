@@ -1,47 +1,12 @@
 #!/bin/bash
-# One-shot TPU perf session: probe the chip, then collect the full perf
-# matrix (step variants at the reference batch and a large remat batch),
-# the loss-variant timings, and a bench.py run. Appends everything to the
-# log so a tunnel drop mid-session loses nothing. Run whenever the tunnel
-# is alive:  bash scripts/tpu_perf_session.sh /tmp/perf_matrix.log
+# One-shot TPU perf session: probe the chip once and, if alive, collect
+# the full evidence matrix (compiled Pallas vs XLA loss, remat @ 2048,
+# the 100-step variant matrix at batch 512, and a bench.py capture
+# refresh). Thin wrapper over scripts/tpu_watch.sh's one-shot mode so
+# the stage list lives in exactly one place; a fresh state dir means
+# every stage runs regardless of what a long-running watcher already
+# collected.  Usage: bash scripts/tpu_perf_session.sh [log]
 set -u
 LOG="${1:-/tmp/perf_matrix.log}"
 cd "$(dirname "$0")/.."
-
-echo "=== perf session $(date -u +%FT%TZ) ===" >> "$LOG"
-
-echo "--- probe ---" >> "$LOG"
-PROBE_OUT=$(mktemp)
-timeout 120 python -c "
-import jax, jax.numpy as jnp, time
-t0 = time.time()
-x = jnp.ones((256, 256), jnp.bfloat16)
-v = float((x @ x).sum())
-print('PROBE_OK', jax.default_backend(), len(jax.devices()), round(time.time()-t0, 1))
-" > "$PROBE_OUT" 2>&1
-cat "$PROBE_OUT" >> "$LOG"
-if ! grep -q PROBE_OK "$PROBE_OUT"; then
-    rm -f "$PROBE_OUT"
-    echo "probe failed; aborting" >> "$LOG"
-    exit 1
-fi
-rm -f "$PROBE_OUT"
-
-echo "--- variants @ batch 512 ---" >> "$LOG"
-timeout 1800 python scripts/perf_explore.py --steps 100 --batch 512 >> "$LOG" 2>&1
-
-echo "--- remat @ batch 2048 ---" >> "$LOG"
-timeout 1200 python scripts/perf_explore.py --steps 30 --batch 2048 \
-    --variants two_pass_remat >> "$LOG" 2>&1
-
-echo "--- loss impls (xla vs pallas) @ batch 512..4096 ---" >> "$LOG"
-timeout 1200 python scripts/perf_loss_variants.py --steps 100 \
-    --batches 512,1024,2048,4096 >> "$LOG" 2>&1
-
-echo "--- bench.py ---" >> "$LOG"
-# short probe budget: this session's own probe just succeeded. A live TPU
-# measurement self-persists to BENCH_TPU_CAPTURE.json — commit it so the
-# driver's end-of-round bench can emit it even if the tunnel dies again.
-BENCH_PROBE_BUDGET_S=300 timeout 1200 python bench.py >> "$LOG" 2>&1
-
-echo "=== session done $(date -u +%FT%TZ) ===" >> "$LOG"
+TPU_WATCH_ONESHOT=1 exec bash scripts/tpu_watch.sh "$LOG" "$(mktemp -d)"

@@ -1,0 +1,159 @@
+#!/bin/bash
+# Opportunistic TPU evidence collector (VERDICT r2 item 1: convert any
+# tunnel window into captured numbers). Probes the chip on an interval;
+# the moment a probe succeeds, runs the evidence stages MISSING-FIRST so
+# a short window still collects the highest-value data. Per-stage marker
+# files make the collection resumable across separate tunnel windows.
+#
+# Trust model: a stage marker means "this evidence was collected on the
+# accelerator". Three guards back that up: the probe rejects a CPU
+# backend; JAX_PLATFORMS must carry a non-cpu pin (this environment pins
+# `axon`, under which a failed device init raises instead of falling
+# back to CPU); and a stage failure aborts the window so a dead tunnel
+# costs one stage timeout, not all four back-to-back.
+#
+# Usage: bash scripts/tpu_watch.sh [log] [state_dir] [max_hours]
+#   TPU_WATCH_ONESHOT=1  probe once; if alive run the stages once and
+#   exit (no loop) — this is scripts/tpu_perf_session.sh's mode, so the
+#   one-shot and watcher paths share a single stage-list definition.
+set -u
+LOG="${1:-/root/repo/docs/perf_session_r3.log}"
+STATE="${2:-/tmp/tpu_watch_state}"
+MAX_HOURS="${3:-11}"
+cd "$(dirname "$0")/.."
+mkdir -p "$STATE"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+# machine-global lock (NOT per state dir — the resource being protected
+# is the single chip): a watcher and a one-shot session running stages
+# concurrently would record contended timings as evidence
+exec 9>"${TPU_WATCH_LOCK:-/tmp/tpu_watch.lock}"
+if ! flock -n 9; then
+    echo "another tpu_watch/perf-session is already running" >&2
+    exit 1
+fi
+
+case "${JAX_PLATFORMS:-}" in
+    ""|*cpu*)
+        echo "refusing to watch: JAX_PLATFORMS='${JAX_PLATFORMS:-}' would allow" \
+             "a silent CPU fallback to masquerade as TPU evidence" >&2
+        exit 1 ;;
+esac
+
+probe() {
+    local out
+    out=$(timeout 100 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+assert float((x @ x).sum()) > 0
+print('PROBE_OK', jax.default_backend(), len(jax.devices()))
+" 2>/dev/null)
+    # reject a CPU backend explicitly (mirrors bench.py's probe)
+    echo "$out" | grep -q "PROBE_OK" && ! echo "$out" | grep -q "PROBE_OK cpu"
+}
+
+# stage <name> <timeout_s> <cmd...>: run once ever; marker on success;
+# nonzero return aborts the current window (caller re-probes). A stage
+# that fails MAX_STAGE_FAILS times is skipped thereafter (return 0, no
+# marker) so one deterministic crash can't starve the later stages; and
+# no stage starts past the deadline, bounding budget overrun to one
+# stage's timeout instead of the whole window's.
+MAX_STAGE_FAILS=3
+stage() {
+    local name="$1" tmo="$2"; shift 2
+    [ -f "$STATE/$name.done" ] && return 0
+    local fails
+    fails=$(cat "$STATE/$name.fails" 2>/dev/null || echo 0)
+    if [ "$fails" -ge "$MAX_STAGE_FAILS" ]; then
+        return 0  # skip-ahead: let later stages use the window
+    fi
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+        return 1
+    fi
+    echo "--- stage $name $(date -u +%FT%TZ) ---" >> "$LOG"
+    if timeout "$tmo" "$@" >> "$LOG" 2>&1; then
+        touch "$STATE/$name.done"
+        echo "--- stage $name DONE ---" >> "$LOG"
+        return 0
+    fi
+    echo $(( fails + 1 )) > "$STATE/$name.fails"
+    echo "--- stage $name FAILED/timeout ($((fails + 1))/$MAX_STAGE_FAILS); re-probing ---" >> "$LOG"
+    return 1
+}
+
+# bench.py exits 0 even when it merely re-emits the committed capture
+# after its own probe fails — only a fresher BENCH_TPU_CAPTURE.json
+# counts as a refresh.
+bench_stage() {
+    [ -f "$STATE/bench.done" ] && return 0
+    local fails before after
+    fails=$(cat "$STATE/bench.fails" 2>/dev/null || echo 0)
+    if [ "$fails" -ge "$MAX_STAGE_FAILS" ]; then
+        return 0
+    fi
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+        return 1
+    fi
+    before=$(stat -c %Y BENCH_TPU_CAPTURE.json 2>/dev/null || echo 0)
+    echo "--- stage bench $(date -u +%FT%TZ) ---" >> "$LOG"
+    timeout 1200 env BENCH_PROBE_BUDGET_S=120 python bench.py >> "$LOG" 2>&1
+    after=$(stat -c %Y BENCH_TPU_CAPTURE.json 2>/dev/null || echo 0)
+    if [ "$after" -gt "$before" ]; then
+        touch "$STATE/bench.done"
+        echo "--- stage bench DONE (capture refreshed) ---" >> "$LOG"
+        return 0
+    fi
+    echo $(( fails + 1 )) > "$STATE/bench.fails"
+    echo "--- stage bench: no fresh capture ($((fails + 1))/$MAX_STAGE_FAILS); re-probing ---" >> "$LOG"
+    return 1
+}
+
+all_done() {
+    [ -f "$STATE/loss_variants.done" ] && [ -f "$STATE/remat2048.done" ] \
+        && [ -f "$STATE/explore512.done" ] && [ -f "$STATE/bench.done" ]
+}
+
+# THE stage list (missing-first by evidence value); returns nonzero if a
+# stage failed so the caller can re-probe instead of burning the
+# remaining stages' timeouts on a dead tunnel
+collect_window() {
+    echo "=== tunnel alive $(date -u +%FT%TZ); collecting (missing-first) ===" >> "$LOG"
+    # 1. compiled Pallas vs XLA — the one axis with zero evidence
+    stage loss_variants 1500 python scripts/perf_loss_variants.py \
+        --steps 100 --batches 512,1024,2048,4096 || return 1
+    # 2. remat at large batch — pod-recipe knob, never timed on TPU
+    stage remat2048 1200 python scripts/perf_explore.py \
+        --steps 30 --batch 2048 --variants two_pass_remat || return 1
+    # 3. full step-variant matrix at the reference batch
+    stage explore512 1800 python scripts/perf_explore.py \
+        --steps 100 --batch 512 || return 1
+    # 4. refresh the committed bench capture (self-persists)
+    bench_stage
+}
+
+if [ "${TPU_WATCH_ONESHOT:-}" = "1" ]; then
+    echo "=== tpu_watch one-shot $(date -u +%FT%TZ) ===" >> "$LOG"
+    if ! probe; then
+        echo "probe failed; aborting" >> "$LOG"
+        exit 1
+    fi
+    collect_window
+    exit $?
+fi
+
+echo "=== tpu_watch start $(date -u +%FT%TZ) (budget ${MAX_HOURS}h) ===" >> "$LOG"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if all_done; then
+        echo "=== tpu_watch: all evidence collected $(date -u +%FT%TZ) ===" >> "$LOG"
+        exit 0
+    fi
+    if probe; then
+        # pause either way: a fast deterministic stage failure (or an
+        # all-skipped window) must not become a probe/collect busy loop
+        collect_window || true
+        sleep 60
+    else
+        sleep 150
+    fi
+done
+echo "=== tpu_watch: budget exhausted $(date -u +%FT%TZ) ===" >> "$LOG"

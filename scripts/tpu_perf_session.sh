@@ -1,8 +1,9 @@
 #!/bin/bash
 # One-shot TPU perf session: probe the chip once and, if alive, collect
 # the full evidence matrix (compiled Pallas vs XLA loss, remat @ 2048,
-# the 100-step variant matrix at batch 512, and a bench.py capture
-# refresh). Thin wrapper over scripts/tpu_watch.sh's one-shot mode so
+# the 100-step variant matrix at batch 512, a bench.py capture refresh,
+# and batch-1024 headroom). Thin wrapper over scripts/tpu_watch.sh's
+# one-shot mode so
 # the stage list lives in exactly one place; a fresh state dir means
 # every stage runs regardless of what a long-running watcher already
 # collected.  Usage: bash scripts/tpu_perf_session.sh [log]

@@ -6,16 +6,28 @@
 # files make the collection resumable across separate tunnel windows.
 #
 # Trust model: a stage marker means "this evidence was collected on the
-# accelerator". Three guards back that up: the probe rejects a CPU
-# backend; JAX_PLATFORMS must carry a non-cpu pin (this environment pins
-# `axon`, under which a failed device init raises instead of falling
-# back to CPU); and a stage failure aborts the window so a dead tunnel
-# costs one stage timeout, not all four back-to-back.
+# accelerator". Guards: the probe is bench.py's own _PROBE_SRC (one
+# definition) and rejects a CPU backend; JAX_PLATFORMS must carry a
+# non-cpu pin (this environment pins `axon`, under which a failed device
+# init raises instead of falling back to CPU); and every chip-using
+# stage runs under a machine-global PER-STAGE flock that bench.py's
+# orchestrator also takes, so timings are never contended — a driver- or
+# operator-run bench interleaves between stages instead of overlapping
+# them (an instance lock separately prevents duplicate watchers).
+#
+# Failure policy: a stage failure triggers a RE-PROBE — direct evidence
+# of whether the tunnel died (abort the window, stage exit codes are not
+# tunnel diagnostics) or the stage itself is broken (keep going, let the
+# remaining stages use the live window). A stage that has failed
+# MAX_STAGE_FAILS times runs only after every healthy stage had its
+# turn, so a deterministic hang can't eat each window's head; it is
+# still retried every window — a transient-timeout history must never
+# permanently forfeit evidence.
 #
 # Usage: bash scripts/tpu_watch.sh [log] [state_dir] [max_hours]
-#   TPU_WATCH_ONESHOT=1  probe once; if alive run the stages once and
-#   exit (no loop) — this is scripts/tpu_perf_session.sh's mode, so the
-#   one-shot and watcher paths share a single stage-list definition.
+#   TPU_WATCH_ONESHOT=1  probe once; if alive run one collection window
+#   and exit — scripts/tpu_perf_session.sh's mode, so the one-shot and
+#   watcher paths share a single stage-list definition.
 set -u
 LOG="${1:-/root/repo/docs/perf_session_r3.log}"
 STATE="${2:-/tmp/tpu_watch_state}"
@@ -23,15 +35,8 @@ MAX_HOURS="${3:-11}"
 cd "$(dirname "$0")/.."
 mkdir -p "$STATE"
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
-
-# machine-global lock (NOT per state dir — the resource being protected
-# is the single chip): a watcher and a one-shot session running stages
-# concurrently would record contended timings as evidence
-exec 9>"${TPU_WATCH_LOCK:-/tmp/tpu_watch.lock}"
-if ! flock -n 9; then
-    echo "another tpu_watch/perf-session is already running" >&2
-    exit 1
-fi
+MAX_STAGE_FAILS=3
+STAGES="loss_variants remat2048 explore512 bench explore1024"
 
 case "${JAX_PLATFORMS:-}" in
     ""|*cpu*)
@@ -40,95 +45,135 @@ case "${JAX_PLATFORMS:-}" in
         exit 1 ;;
 esac
 
+# instance lock: one watcher per state dir (two would race the markers)
+exec 9>"$STATE/instance.lock"
+if ! flock -n 9; then
+    echo "another tpu_watch is already running on $STATE" >&2
+    exit 1
+fi
+
+# chip lock: held only WHILE a stage runs (flock -w around each stage
+# command), never across stages or sleeps — so a driver-run bench.py,
+# which takes the same lock (bench._acquire_chip_lock), serializes
+# against stages instead of measuring a contended chip or waiting out
+# the watcher's whole lifetime
+CHIP_LOCK="${TPU_WATCH_LOCK:-/tmp/tpu_watch.lock}"
+CHIP_LOCK_WAIT=1800
+
+# bench.py's probe source verbatim (one definition); PROBE_OK must appear
+# on stdout and name a non-cpu backend. Failed-probe diagnostics go to
+# the log at most once per 30 min so an hours-long outage stays readable.
 probe() {
-    local out
-    out=$(timeout 100 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((256, 256), jnp.bfloat16)
-assert float((x @ x).sum()) > 0
-print('PROBE_OK', jax.default_backend(), len(jax.devices()))
-" 2>/dev/null)
-    # reject a CPU backend explicitly (mirrors bench.py's probe)
-    echo "$out" | grep -q "PROBE_OK" && ! echo "$out" | grep -q "PROBE_OK cpu"
+    local out err rc now last
+    err=$(mktemp)
+    out=$(timeout 100 python -c \
+        'import bench; exec(bench._PROBE_SRC)' 2>"$err")
+    rc=$?
+    if [ "$rc" -eq 0 ] && echo "$out" | grep -q "PROBE_OK" \
+            && ! echo "$out" | grep -q "cpu"; then
+        rm -f "$err"
+        return 0
+    fi
+    now=$(date +%s)
+    last=$(cat "$STATE/.probe_log_ts" 2>/dev/null || echo 0)
+    if [ $(( now - last )) -ge 1800 ]; then
+        echo "$now" > "$STATE/.probe_log_ts"
+        {
+            echo "--- probe failed $(date -u +%FT%TZ) rc=$rc out='$out' stderr tail:"
+            tail -3 "$err"
+        } >> "$LOG"
+    fi
+    rm -f "$err"
+    return 1
 }
 
-# stage <name> <timeout_s> <cmd...>: run once ever; marker on success;
-# nonzero return aborts the current window (caller re-probes). A stage
-# that fails MAX_STAGE_FAILS times is skipped thereafter (return 0, no
-# marker) so one deterministic crash can't starve the later stages; and
-# no stage starts past the deadline, bounding budget overrun to one
-# stage's timeout instead of the whole window's.
-MAX_STAGE_FAILS=3
-stage() {
-    local name="$1" tmo="$2"; shift 2
-    [ -f "$STATE/$name.done" ] && return 0
-    local fails
-    fails=$(cat "$STATE/$name.fails" 2>/dev/null || echo 0)
-    if [ "$fails" -ge "$MAX_STAGE_FAILS" ]; then
-        return 0  # skip-ahead: let later stages use the window
-    fi
+fails_of() { cat "$STATE/$1.fails" 2>/dev/null || echo 0; }
+
+# run_stage <name>: execute one evidence stage; marker on success.
+# bench is special-cased: bench.py exits 0 even when it merely re-emits
+# the committed capture after its own probe fails, so only a fresher
+# BENCH_TPU_CAPTURE.json counts.
+run_stage() {
+    local name="$1" rc before after
     if [ "$(date +%s)" -ge "$DEADLINE" ]; then
         return 1
     fi
     echo "--- stage $name $(date -u +%FT%TZ) ---" >> "$LOG"
-    if timeout "$tmo" "$@" >> "$LOG" 2>&1; then
+    case "$name" in
+        loss_variants)
+            flock -w "$CHIP_LOCK_WAIT" "$CHIP_LOCK" \
+                timeout 1500 python scripts/perf_loss_variants.py \
+                --steps 100 --batches 512,1024,2048,4096 >> "$LOG" 2>&1
+            rc=$? ;;
+        remat2048)
+            flock -w "$CHIP_LOCK_WAIT" "$CHIP_LOCK" \
+                timeout 1200 python scripts/perf_explore.py \
+                --steps 30 --batch 2048 --variants two_pass_remat >> "$LOG" 2>&1
+            rc=$? ;;
+        explore512)
+            flock -w "$CHIP_LOCK_WAIT" "$CHIP_LOCK" \
+                timeout 1800 python scripts/perf_explore.py \
+                --steps 100 --batch 512 >> "$LOG" 2>&1
+            rc=$? ;;
+        explore1024)
+            flock -w "$CHIP_LOCK_WAIT" "$CHIP_LOCK" \
+                timeout 1200 python scripts/perf_explore.py \
+                --steps 50 --batch 1024 >> "$LOG" 2>&1
+            rc=$? ;;
+        bench)
+            # bench.py takes the chip lock itself (BENCH_LOCK_WAIT_S
+            # bounded below the outer timeout so contention can't look
+            # like a hang)
+            before=$(stat -c %Y BENCH_TPU_CAPTURE.json 2>/dev/null || echo 0)
+            timeout 1500 env BENCH_PROBE_BUDGET_S=120 BENCH_LOCK_WAIT_S=300 \
+                python bench.py >> "$LOG" 2>&1
+            after=$(stat -c %Y BENCH_TPU_CAPTURE.json 2>/dev/null || echo 0)
+            [ "$after" -gt "$before" ]; rc=$? ;;
+        *)  echo "unknown stage $name" >> "$LOG"; return 1 ;;
+    esac
+    if [ "$rc" -eq 0 ]; then
         touch "$STATE/$name.done"
         echo "--- stage $name DONE ---" >> "$LOG"
         return 0
     fi
-    echo $(( fails + 1 )) > "$STATE/$name.fails"
-    echo "--- stage $name FAILED/timeout ($((fails + 1))/$MAX_STAGE_FAILS); re-probing ---" >> "$LOG"
-    return 1
-}
-
-# bench.py exits 0 even when it merely re-emits the committed capture
-# after its own probe fails — only a fresher BENCH_TPU_CAPTURE.json
-# counts as a refresh.
-bench_stage() {
-    [ -f "$STATE/bench.done" ] && return 0
-    local fails before after
-    fails=$(cat "$STATE/bench.fails" 2>/dev/null || echo 0)
-    if [ "$fails" -ge "$MAX_STAGE_FAILS" ]; then
-        return 0
-    fi
-    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
-        return 1
-    fi
-    before=$(stat -c %Y BENCH_TPU_CAPTURE.json 2>/dev/null || echo 0)
-    echo "--- stage bench $(date -u +%FT%TZ) ---" >> "$LOG"
-    timeout 1200 env BENCH_PROBE_BUDGET_S=120 python bench.py >> "$LOG" 2>&1
-    after=$(stat -c %Y BENCH_TPU_CAPTURE.json 2>/dev/null || echo 0)
-    if [ "$after" -gt "$before" ]; then
-        touch "$STATE/bench.done"
-        echo "--- stage bench DONE (capture refreshed) ---" >> "$LOG"
-        return 0
-    fi
-    echo $(( fails + 1 )) > "$STATE/bench.fails"
-    echo "--- stage bench: no fresh capture ($((fails + 1))/$MAX_STAGE_FAILS); re-probing ---" >> "$LOG"
+    echo $(( $(fails_of "$name") + 1 )) > "$STATE/$name.fails"
+    echo "--- stage $name FAILED/timeout (fails=$(fails_of "$name")) ---" >> "$LOG"
     return 1
 }
 
 all_done() {
-    [ -f "$STATE/loss_variants.done" ] && [ -f "$STATE/remat2048.done" ] \
-        && [ -f "$STATE/explore512.done" ] && [ -f "$STATE/bench.done" ]
+    local s
+    for s in $STAGES; do
+        [ -f "$STATE/$s.done" ] || return 1
+    done
+    return 0
 }
 
-# THE stage list (missing-first by evidence value); returns nonzero if a
-# stage failed so the caller can re-probe instead of burning the
-# remaining stages' timeouts on a dead tunnel
+# One collection window: healthy stages first, repeat offenders last; a
+# stage failure re-probes — dead tunnel aborts the window, a live one
+# continues so a single broken stage can't forfeit the rest.
 collect_window() {
     echo "=== tunnel alive $(date -u +%FT%TZ); collecting (missing-first) ===" >> "$LOG"
-    # 1. compiled Pallas vs XLA — the one axis with zero evidence
-    stage loss_variants 1500 python scripts/perf_loss_variants.py \
-        --steps 100 --batches 512,1024,2048,4096 || return 1
-    # 2. remat at large batch — pod-recipe knob, never timed on TPU
-    stage remat2048 1200 python scripts/perf_explore.py \
-        --steps 30 --batch 2048 --variants two_pass_remat || return 1
-    # 3. full step-variant matrix at the reference batch
-    stage explore512 1800 python scripts/perf_explore.py \
-        --steps 100 --batch 512 || return 1
-    # 4. refresh the committed bench capture (self-persists)
-    bench_stage
+    local s deferred=""
+    for s in $STAGES; do
+        [ "$(date +%s)" -ge "$DEADLINE" ] && return 1
+        [ -f "$STATE/$s.done" ] && continue
+        if [ "$(fails_of "$s")" -ge "$MAX_STAGE_FAILS" ]; then
+            deferred="$deferred $s"
+            continue
+        fi
+        if ! run_stage "$s"; then
+            # re-probe: dead tunnel → abort the window; alive → the stage
+            # itself is broken, let the remaining stages use the window
+            probe || return 1
+        fi
+    done
+    for s in $deferred; do
+        [ "$(date +%s)" -ge "$DEADLINE" ] && return 1
+        probe || return 1
+        run_stage "$s" || true
+    done
+    return 0
 }
 
 if [ "${TPU_WATCH_ONESHOT:-}" = "1" ]; then
@@ -148,8 +193,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         exit 0
     fi
     if probe; then
-        # pause either way: a fast deterministic stage failure (or an
-        # all-skipped window) must not become a probe/collect busy loop
+        # pause either way: a fast-failing window must not busy-loop
         collect_window || true
         sleep 60
     else

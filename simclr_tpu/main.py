@@ -267,13 +267,11 @@ def run_pretrain(cfg: Config) -> dict:
         # the shared f32 extraction model — the monitor's accuracy is
         # directly comparable to a post-hoc eval.py centroid run on the
         # same checkpoint regardless of the training compute dtype
-        from simclr_tpu.eval import build_eval_model
+        from simclr_tpu.eval import build_eval_model, centroid_probe, extract_features
 
         monitor_model = build_eval_model(cfg)
 
         def run_monitor_probe(epoch: int) -> float:
-            from simclr_tpu.eval import centroid_probe, extract_features
-
             variables = gather_replicated(
                 {"params": state.params, "batch_stats": state.batch_stats}
             )

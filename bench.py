@@ -434,8 +434,11 @@ def _acquire_chip_lock(wait_s: float):
 
 
 def main() -> None:
+    # default bounded well below any plausible driver timeout: the lock is
+    # only ever held while a watcher stage is actively timing on a LIVE
+    # tunnel, and a 10-min wait covers most of one stage
     _chip_lock = _acquire_chip_lock(
-        float(os.environ.get("BENCH_LOCK_WAIT_S", 1800))
+        float(os.environ.get("BENCH_LOCK_WAIT_S", 600))
     )
     capture = load_tpu_capture()
     budget = float(

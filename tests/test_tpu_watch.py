@@ -1,0 +1,130 @@
+"""scripts/tpu_watch.sh contract (the round's TPU evidence collector).
+
+The watcher converts rare tunnel windows into perf evidence; a silent
+regression in its marker/deferral logic forfeits hardware numbers, so the
+shell orchestration is pinned here. Each test runs the script's ONE-SHOT
+mode in a subprocess with a stub ``python`` prepended to PATH — no jax, no
+chip: the stub answers the probe and the evidence stages per-scenario and
+records every invocation, so assertions cover which stages ran, which
+markers/fail-counters were written, and what a failing stage does to the
+rest of the window.
+"""
+
+import os
+import stat
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
+STAGES = ("loss_variants", "remat2048", "explore512", "bench", "explore1024")
+
+
+def _write_stub(tmp_path, fail_scripts=(), probe_ok=True):
+    """A fake ``python`` that logs argv and scripts/ stage outcomes.
+
+    The probe (``-c 'import bench; ...'``) prints bench.py's PROBE_OK line;
+    a stage invocation exits 0 unless its script name is in
+    ``fail_scripts``; the bench stage touches BENCH_TPU_CAPTURE.json (mtime
+    freshness is its success criterion — content untouched).
+    """
+    calls = tmp_path / "calls.log"
+    stub = tmp_path / "bin" / "python"
+    stub.parent.mkdir()
+    lines = ["#!/bin/bash", f'echo "$@" >> "{calls}"']
+    if probe_ok:
+        lines += ['case "$*" in *"import bench"*) echo "PROBE_OK tpu 1"; exit 0;; esac']
+    else:
+        lines += ['case "$*" in *"import bench"*) echo "no devices" >&2; exit 1;; esac']
+    for name in fail_scripts:
+        lines += [f'case "$*" in *{name}*) exit 1;; esac']
+    lines += [
+        # sleep first: the stage's freshness check compares whole-second
+        # mtimes, and consecutive tests touch the same file
+        'case "$*" in *bench.py*) sleep 1; touch "$(pwd)/BENCH_TPU_CAPTURE.json";; esac',
+        "exit 0",
+    ]
+    stub.write_text("\n".join(lines) + "\n")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    return calls
+
+
+def _run_oneshot(tmp_path, timeout=60):
+    state = tmp_path / "state"
+    log = tmp_path / "watch.log"
+    env = dict(os.environ)
+    env["PATH"] = f"{tmp_path / 'bin'}:{env['PATH']}"
+    env["TPU_WATCH_ONESHOT"] = "1"
+    env["TPU_WATCH_LOCK"] = str(tmp_path / "chip.lock")
+    # conftest pins JAX_PLATFORMS=cpu in this process; the watcher refuses a
+    # cpu-capable pin, and the stub python never imports jax anyway
+    env["JAX_PLATFORMS"] = "axon"
+    r = subprocess.run(
+        ["bash", WATCH, str(log), str(state)],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+    return r, state, log
+
+
+def _done(state):
+    return {s for s in STAGES if (state / f"{s}.done").exists()}
+
+
+def test_all_stages_collect_and_mark_done(tmp_path):
+    calls = _write_stub(tmp_path)
+    r, state, log = _run_oneshot(tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert _done(state) == set(STAGES)
+    text = calls.read_text()
+    # missing-first order: the zero-evidence Pallas comparison leads
+    assert text.index("perf_loss_variants.py") < text.index("bench.py")
+    assert "collecting (missing-first)" in log.read_text()
+
+
+def test_failing_stage_does_not_forfeit_live_window(tmp_path):
+    """A deterministic stage crash must not abort a live window: the watcher
+    re-probes (alive) and continues, records the fail count, and leaves no
+    done-marker for the crasher."""
+    _write_stub(tmp_path, fail_scripts=("perf_loss_variants.py",))
+    r, state, log = _run_oneshot(tmp_path)
+    assert _done(state) == set(STAGES) - {"loss_variants"}
+    assert (state / "loss_variants.fails").read_text().strip() == "1"
+    assert "stage loss_variants FAILED" in log.read_text()
+
+
+def test_dead_probe_aborts_before_any_stage(tmp_path):
+    calls = _write_stub(tmp_path, probe_ok=False)
+    r, state, log = _run_oneshot(tmp_path)
+    assert r.returncode == 1
+    assert _done(state) == set()
+    assert "probe failed" in log.read_text()
+    assert "perf_explore.py" not in calls.read_text()
+
+
+def test_bench_marker_requires_fresh_capture(tmp_path):
+    """bench.py exiting 0 without refreshing BENCH_TPU_CAPTURE.json (its
+    tunnel-down re-emit path) must not earn bench.done."""
+    calls = _write_stub(tmp_path)
+    # rewrite the stub so bench.py succeeds but does NOT touch the capture
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace("touch ", ": noop "))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "bench" not in _done(state)
+    assert (state / "bench.fails").exists()
+    assert "stage bench FAILED" in log.read_text()
+
+
+def test_repeat_offender_is_deferred_not_skipped(tmp_path):
+    """A stage at the fail cap runs AFTER the healthy stages (window head
+    protected) but is still attempted — a transient-timeout history must
+    never permanently forfeit evidence."""
+    calls = _write_stub(tmp_path)
+    state = tmp_path / "state"
+    state.mkdir()
+    (state / "loss_variants.fails").write_text("3\n")
+    r, state, log = _run_oneshot(tmp_path)
+    text = calls.read_text()
+    assert "perf_loss_variants.py" in text, "deferred stage must still run"
+    assert text.index("bench.py") < text.index("perf_loss_variants.py")
+    assert _done(state) == set(STAGES)

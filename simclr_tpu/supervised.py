@@ -8,6 +8,11 @@ distributed validation pass after every epoch — the reference's
 validation loss or accuracy, deleting the previous best
 (``supervised.py:144-162``).
 
+Improvement over the reference, by design (like main.py's):
+``experiment.resume=true`` restores the persisted best checkpoint and
+continues from its epoch — the reference restarts 200-epoch runs from
+scratch on any failure (no checkpoint-load path, SURVEY §5.3).
+
     python -m simclr_tpu.supervised parameter.epochs=200
 """
 
@@ -46,7 +51,14 @@ from simclr_tpu.parallel.steps import (
     make_supervised_step,
 )
 from simclr_tpu.parallel.train_state import create_train_state, param_count
-from simclr_tpu.utils.checkpoint import checkpoint_name, delete_checkpoint, save_checkpoint
+from simclr_tpu.utils.checkpoint import (
+    checkpoint_name,
+    delete_checkpoint,
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from simclr_tpu.utils.logging import get_logger, is_logging_host
 from simclr_tpu.utils.profiling import StepTimer, StepTraceWindow
 from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
@@ -163,6 +175,25 @@ def run_supervised(cfg: Config) -> dict:
         val_valid = np.concatenate([val_valid, np.zeros(val_pad, np.float32)])
     val_local = process_local_rows(global_batch)
 
+    def run_validation(st) -> tuple[float, float]:
+        """One full distributed validation sweep (reference
+        supervised.py:30-58,135-139); the tail batch rides the same jitted
+        step via the valid mask."""
+        sum_loss, correct, count = 0.0, 0.0, 0.0
+        for start in range(0, val_steps * global_batch, global_batch):
+            sl = slice(start, start + global_batch)
+            totals = eval_step(
+                st.params,
+                st.batch_stats,
+                put_global_batch(val_images[sl][val_local], data_shard),
+                put_global_batch(val_labels[sl][val_local], data_shard),
+                put_global_batch(val_valid[sl][val_local], data_shard),
+            )
+            sum_loss += float(totals["sum_loss"])
+            correct += float(totals["correct"])
+            count += float(totals["count"])
+        return sum_loss / max(count, 1.0), correct / max(count, 1.0)
+
     save_dir = resolve_save_dir(cfg)
     metric = str(cfg.parameter.metric)
     if is_logging_host():
@@ -177,9 +208,38 @@ def run_supervised(cfg: Config) -> dict:
     best_value = None
     best_path = None
     best_epoch = 0
+    start_epoch = 1
+    # Resume (VERDICT r3 item 6) — the same latest→restore→start_epoch
+    # mechanism as main.py, adapted to the best-only deletion policy: the
+    # only checkpoint on disk IS the previous best, so training rewinds to
+    # the best epoch (later non-best progress was never persisted, by the
+    # reference's own policy, supervised.py:151-162). One re-validation of
+    # the restored state re-establishes best_value/best_path so the first
+    # post-resume epoch can't spuriously "improve" over None and delete the
+    # checkpoint it just resumed from.
+    if bool(cfg.select("experiment.resume", False)):
+        ckpt = latest_checkpoint(save_dir)
+        if ckpt is not None:
+            # a crash between save-new-best and delete-old-best can leave two
+            # checkpoints; keep the newest (it won the comparison) and
+            # restore the best-only invariant
+            for stale in list_checkpoints(save_dir)[:-1]:
+                delete_checkpoint(stale)
+            state = restore_checkpoint(ckpt, state)
+            start_epoch = int(state.step) // max(steps_per_epoch, 1) + 1
+            val_loss, val_acc = run_validation(state)
+            best_value = val_loss if metric == "loss" else val_acc
+            best_path = ckpt
+            best_epoch = start_epoch - 1
+            if is_logging_host():
+                logger.info(
+                    "Resumed from %s at epoch %d (best %s=%.4f re-validated)",
+                    ckpt, start_epoch, metric, best_value,
+                )
     history = []
     t_start = time.time()
-    cur_step = 0  # host-side mirror of state.step: avoids per-step device sync
+    # host-side mirror of state.step: avoids per-step device sync
+    cur_step = (start_epoch - 1) * steps_per_epoch
     # steady-state training throughput like main.py's: validation sweeps and
     # checkpoint I/O are pause()d out of the timed window. In epoch_compile
     # mode one tick covers a whole epoch of steps.
@@ -189,12 +249,14 @@ def run_supervised(cfg: Config) -> dict:
     )
     tracer = StepTraceWindow(
         cfg.select("experiment.profile_dir"),
-        start=2,
+        start=cur_step + 2,
         length=int(cfg.select("experiment.profile_steps", 10) or 10),
         enabled=is_logging_host(),
     )
-    for epoch in range(1, epochs + 1):
-        train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
+    # bound before the loop: a resume whose start_epoch exceeds epochs (the
+    # run already completed) must still reach tracer.close/timer.summary
+    train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
+    for epoch in range(start_epoch, epochs + 1):
         if epoch_compile:
             idx_e = jnp.asarray(
                 epoch_index_matrix(
@@ -217,28 +279,14 @@ def run_supervised(cfg: Config) -> dict:
                 timer.tick(train_metrics["loss"])
                 cur_step += 1
 
-        # distributed validation (reference supervised.py:30-58,135-139);
-        # tail batch rides the same jitted step via the valid mask
         timer.pause(train_metrics["loss"])  # keep eval out of the imgs/sec window
-        sum_loss, correct, count = 0.0, 0.0, 0.0
-        for start in range(0, val_steps * global_batch, global_batch):
-            sl = slice(start, start + global_batch)
-            totals = eval_step(
-                state.params,
-                state.batch_stats,
-                put_global_batch(val_images[sl][val_local], data_shard),
-                put_global_batch(val_labels[sl][val_local], data_shard),
-                put_global_batch(val_valid[sl][val_local], data_shard),
-            )
-            sum_loss += float(totals["sum_loss"])
-            correct += float(totals["correct"])
-            count += float(totals["count"])
-
-        val_loss = sum_loss / max(count, 1.0)
-        val_acc = correct / max(count, 1.0)
+        val_loss, val_acc = run_validation(state)
         history.append({"epoch": epoch, "val_loss": val_loss, "val_acc": val_acc})
         if is_logging_host():
-            imgs_per_sec = cur_step * global_batch / max(time.time() - t_start, 1e-9)
+            imgs_per_sec = (
+                (cur_step - (start_epoch - 1) * steps_per_epoch)
+                * global_batch / max(time.time() - t_start, 1e-9)
+            )
             logger.info(
                 "Epoch:%d/%d progress:%.3f train_loss:%.3f val_loss:%.4f "
                 "val_acc:%.4f lr:%.7f imgs/sec(cum):%.0f",
@@ -253,8 +301,10 @@ def run_supervised(cfg: Config) -> dict:
             value < best_value if metric == "loss" else value > best_value
         )
         if improved:
-            if best_path is not None:
-                delete_checkpoint(best_path)
+            # save the NEW best before deleting the old one: a crash between
+            # the two must leave at least one resumable checkpoint on disk
+            # (orbax writes are atomic; epoch-numbered names never collide)
+            prev_best = best_path
             best_value = value
             best_epoch = epoch
             best_path = os.path.join(
@@ -262,6 +312,8 @@ def run_supervised(cfg: Config) -> dict:
                 checkpoint_name(epoch, f"supervised-{cfg.experiment.name}.pt"),
             )
             save_checkpoint(best_path, state)
+            if prev_best is not None:
+                delete_checkpoint(prev_best)
         timer.resume()
 
     tracer.close(pending=train_metrics["loss"])

@@ -51,6 +51,11 @@ def list_checkpoints(target_dir: str) -> list[str]:
     out = []
     for entry in os.listdir(target_dir):
         full = os.path.join(target_dir, entry)
+        # skip orbax's in-progress tmp dirs (name carries the final dir's
+        # "epoch=" prefix): a crash mid-save must not offer a half-written
+        # checkpoint to resume/eval
+        if "orbax-checkpoint-tmp" in entry:
+            continue
         if os.path.isdir(full) and _EPOCH_RE.search(entry):
             out.append(full)
     return sorted(out, key=epoch_of)

@@ -1,0 +1,78 @@
+"""scripts/preflight_1000epoch.py contract (VERDICT r3 item 3).
+
+The preflight is the conversion lever for the never-yet-run 1000-epoch
+north-star recipe: when a data-capable environment appears, it must say
+"go" only when every recipe precondition genuinely holds, and name the
+first broken one otherwise. No accelerator is involved.
+"""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_preflight():
+    spec = importlib.util.spec_from_file_location(
+        "preflight_1000epoch",
+        os.path.join(REPO, "scripts", "preflight_1000epoch.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_missing_archives_fail_first(tmp_path, monkeypatch, capsys):
+    mod = _load_preflight()
+    monkeypatch.setattr(
+        sys, "argv",
+        ["preflight", "--data-dir", str(tmp_path / "nowhere"),
+         "--save-dir", str(tmp_path / "run")],
+    )
+    with pytest.raises(SystemExit) as exc:
+        mod.main()
+    assert exc.value.code == 1
+    out = capsys.readouterr().out
+    assert "[FAIL] CIFAR-10 archives" in out
+
+
+def test_full_pass_prints_recipe_commands(tmp_path, monkeypatch, capsys):
+    """With a full-size dataset every check passes and the printed commands
+    carry the reference recipe's parity-critical overrides."""
+    from simclr_tpu.data import cifar
+
+    def fake_load(name, split, data_dir=None, **kw):
+        n = 50000 if split == "train" else 10000
+        return cifar.Dataset(
+            images=np.zeros((n, 32, 32, 3), np.uint8),
+            labels=(np.arange(n) % 10).astype(np.int32),
+            name=name,
+            split=split,
+        )
+
+    mod = _load_preflight()
+    monkeypatch.setattr(cifar, "load_dataset", fake_load)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["preflight", "--data-dir", str(tmp_path / "data"),
+         "--save-dir", str(tmp_path / "run")],
+    )
+    mod.main()
+    out = capsys.readouterr().out
+    assert "[FAIL]" not in out
+    assert "All preflight checks passed" in out
+    for needle in (
+        "parameter.epochs=1000",
+        "experiment.batches=512",
+        "mesh.data=4",
+        "loss.negatives=local",
+        "experiment.resume=true",
+        "parameter.classifier=linear",
+    ):
+        assert needle in out, needle
+    # step accounting surfaced: 50000 // 2048 = 24 steps/epoch
+    assert "24 steps/epoch" in out

@@ -89,9 +89,11 @@ def reference_lr(i, total_steps=STEPS):
     return 0.5 * LR0 * (1.0 + math.cos(math.pi * t / t_max))
 
 
-def run_torch_loop(model, views):
+def run_torch_loop(model, views, after_step=None):
     """Reference train loop: two forwards, NT-Xent, LARC(clip=False)+SGD
-    momentum with the ("bias","bn") substring weight-decay skip."""
+    momentum with the ("bias","bn") substring weight-decay skip.
+    ``after_step(i, model)`` (optional) observes the post-update state —
+    the drift-vs-horizon test snapshots through it."""
     decay_flag = {
         name: not any(s in name for s in ("bias", "bn"))
         for name, _ in model.named_parameters()
@@ -120,6 +122,8 @@ def run_torch_loop(model, views):
                 buf.mul_(MOMENTUM).add_(g)  # torch SGD: buf = m*buf + g
                 p.add_(buf, alpha=-lr)
         losses.append(float(loss.detach()))
+        if after_step is not None:
+            after_step(i, model)
     return losses
 
 
@@ -127,7 +131,7 @@ def run_torch_loop(model, views):
 # JAX side: this framework's building blocks, single-device
 # ---------------------------------------------------------------------------
 
-def run_jax_loop(variables, views_np, mask_fn):
+def run_jax_loop(variables, views_np, mask_fn, after_step=None):
     model = ContrastiveModel(base_cnn="resnet18", d=128, dtype=jnp.float32)
     params = jax.tree.map(jnp.asarray, variables["params"])
     stats = jax.tree.map(jnp.asarray, variables["batch_stats"])
@@ -161,11 +165,13 @@ def run_jax_loop(variables, views_np, mask_fn):
         return optax.apply_updates(params, updates), new_stats, new_opt, loss
 
     losses = []
-    for v0, v1 in views_np:
+    for i, (v0, v1) in enumerate(views_np):
         params, stats, opt_state, loss = step(
             params, stats, opt_state, jnp.asarray(v0), jnp.asarray(v1)
         )
         losses.append(float(loss))
+        if after_step is not None:
+            after_step(i, params, stats)
     return losses, params, stats
 
 

@@ -12,7 +12,10 @@ train/val sets, then score a probe:
     ``NonLinearClassifier`` import is a latent defect (SURVEY §2.5.1) — the
     class is reconstructed in ``models/heads.py``.
 
-All results land in one JSON blob (``eval.py:322-325``).
+All results land in one JSON blob (``eval.py:322-325``). Improvement over
+the reference, by design: the blob is persisted after EVERY checkpoint and
+``experiment.resume=true`` skips checkpoints already present, so a crashed
+multi-checkpoint sweep resumes instead of redoing hours of probe training.
 
     python -m simclr_tpu.eval parameter.classifier=linear \
         experiment.target_dir=results/cifar10/seed-7/...
@@ -439,9 +442,54 @@ def run_eval(cfg: Config) -> dict:
 
     checkpoints = list_checkpoints_or_raise(str(cfg.experiment.target_dir))
 
+    fname = str(cfg.parameter.classification_results_json_fname)
+    save_dir = resolve_save_dir(cfg)
+    results_path = os.path.join(save_dir, fname)
+
+    # Incremental + resumable sweep (improvement over the reference, which
+    # writes one blob at the very end, eval.py:322-325, and redoes every
+    # checkpoint after a crash): results persist after EACH checkpoint, and
+    # experiment.resume=true skips checkpoints already in the results file.
+    # Resume assumes the same classifier/flags as the interrupted run — pin
+    # experiment.save_dir for resumable sweeps (the default save_dir is a
+    # fresh dated directory per run). Multi-process: save_dir must be a
+    # shared filesystem, the same contract as checkpoint resume.
     classification_results = {}
+    if bool(cfg.select("experiment.resume", False)) and os.path.exists(results_path):
+        try:
+            with open(results_path) as f:
+                classification_results = json.load(f)
+        except ValueError as exc:
+            # a corrupt results file must not silently turn "resume" into
+            # "redo everything and overwrite the evidence": say why, and
+            # set the original aside before the first persist() replaces it
+            logger.warning(
+                "could not parse %s (%s); starting the sweep fresh — the "
+                "unparseable file is kept at %s.corrupt",
+                results_path, exc, results_path,
+            )
+            if is_logging_host():
+                os.replace(results_path, results_path + ".corrupt")
+            classification_results = {}
+        if classification_results:
+            logger.info(
+                "resuming eval sweep: %d checkpoint(s) already in %s",
+                len(classification_results), results_path,
+            )
+
+    def persist() -> None:
+        if is_logging_host():
+            os.makedirs(save_dir, exist_ok=True)
+            tmp = results_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(classification_results, f)
+            os.replace(tmp, results_path)
+
     for ckpt in checkpoints:
         key = os.path.basename(ckpt)
+        if key in classification_results:
+            logger.info("Skipping %s (already evaluated)", key)
+            continue
         logger.info("Evaluation by using %s", key)
         variables = load_model_variables(ckpt)
         train_X = extract_features(
@@ -469,13 +517,9 @@ def run_eval(cfg: Config) -> dict:
                 results["highest_val_acc"],
             )
         classification_results[key] = results
+        persist()
 
-    fname = str(cfg.parameter.classification_results_json_fname)
-    save_dir = resolve_save_dir(cfg)
-    if is_logging_host():
-        os.makedirs(save_dir, exist_ok=True)
-        with open(os.path.join(save_dir, fname), "w") as f:
-            json.dump(classification_results, f)
+    persist()  # also covers the all-skipped resume (file carried forward)
     return classification_results
 
 

@@ -115,13 +115,40 @@ def run_save_features(cfg: Config) -> list[str]:
     def save(name: str, array: np.ndarray) -> None:
         path = os.path.join(out_dir, name)
         if is_logging_host():
-            np.save(path, array)
+            # atomic: a SIGKILL mid-write must not leave a truncated .npy
+            # that the resume existence-gate would then carry forward as
+            # complete. The file-object form keeps np.save from appending
+            # a second .npy suffix to the tmp name.
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.save(f, array)
+            os.replace(tmp, path)
         written.append(path)
 
     checkpoints = list_checkpoints_or_raise(str(cfg.experiment.target_dir))
 
+    # experiment.resume=true: skip checkpoints whose full export set already
+    # exists — a crashed multi-checkpoint export (20 augmentation passes per
+    # checkpoint are the expensive part) resumes at checkpoint granularity.
+    # Improvement over the reference (redoes everything, save_features.py).
+    # Multi-process: out_dir must be a shared filesystem so every process
+    # makes the SAME skip decision (only process 0 writes; a per-host local
+    # out_dir would desynchronize the collective extract path) — the same
+    # contract as checkpoint and eval-sweep resume.
+    resume = bool(cfg.select("experiment.resume", False))
+
     for ckpt in checkpoints:
         key = os.path.basename(ckpt)
+        expected = [
+            f"{key}.train.features.npy", f"{key}.train.labels.npy",
+            f"{key}.val.features.npy", f"{key}.val.labels.npy",
+        ] + [f"{key}.train.aug-{t}.features.npy" for t in SNAPSHOT_PASSES]
+        if resume and all(
+            os.path.exists(os.path.join(out_dir, p)) for p in expected
+        ):
+            logger.info("Skipping %s (features already exported)", key)
+            written.extend(os.path.join(out_dir, p) for p in expected)
+            continue
         logger.info("Extracting features with %s", key)
         variables = load_model_variables(ckpt)
 

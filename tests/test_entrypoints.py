@@ -147,6 +147,35 @@ class TestEval:
         with open(os.path.join(out, "results.json")) as f:
             assert json.load(f).keys() == results.keys()
 
+    def test_resume_skips_evaluated_checkpoints(self, pretrain_run, tmp_path):
+        """experiment.resume=true on an eval sweep: checkpoints already in
+        the results file are carried verbatim (not recomputed), only the
+        missing ones run, and the incremental per-checkpoint persistence
+        makes a crashed sweep resumable at checkpoint granularity."""
+        out = str(tmp_path / "eval-resume")
+        args = SYNTH + [
+            "parameter.classifier=centroid",
+            f"experiment.target_dir={pretrain_run['save_dir']}",
+            f"experiment.save_dir={out}",
+        ]
+        eval_main(args)
+        path = os.path.join(out, "results.json")
+        with open(path) as f:
+            blob = json.load(f)
+        # simulate a crash after checkpoint 1: drop epoch=2, poison epoch=1
+        # with a sentinel so recomputation would be visible
+        del blob["epoch=2-cifar10"]
+        blob["epoch=1-cifar10"] = {"sentinel": 123}
+        with open(path, "w") as f:
+            json.dump(blob, f)
+
+        resumed = eval_main(args + ["experiment.resume=true"])
+        assert set(resumed.keys()) == {"epoch=1-cifar10", "epoch=2-cifar10"}
+        assert resumed["epoch=1-cifar10"] == {"sentinel": 123}  # skipped
+        assert 0.0 <= resumed["epoch=2-cifar10"]["val_acc"] <= 1.0  # recomputed
+        with open(path) as f:
+            assert json.load(f).keys() == resumed.keys()
+
     @pytest.mark.parametrize("kind", ["linear", "nonlinear"])
     def test_learnable(self, pretrain_run, tmp_path, kind):
         out = str(tmp_path / f"eval-{kind}")
@@ -202,6 +231,35 @@ class TestSaveFeatures:
         assert a1.shape == X.shape
         # averaging over different augmentations must change the features
         assert np.abs(a1 - a2).max() > 0
+
+    def test_resume_skips_complete_exports(self, pretrain_run, tmp_path,
+                                           monkeypatch):
+        """experiment.resume=true: a checkpoint with its full export set on
+        disk is skipped; one with a missing file is re-exported."""
+        import simclr_tpu.save_features as sf
+
+        monkeypatch.setattr(sf, "NUM_AUGMENTATIONS", 1)
+        monkeypatch.setattr(sf, "SNAPSHOT_PASSES", (1,))
+        out = str(tmp_path / "features-resume")
+        args = SYNTH + [
+            f"experiment.target_dir={pretrain_run['save_dir']}",
+            f"experiment.save_dir={out}",
+        ]
+        save_features_main(args)
+        # simulate a crash mid-export of epoch=2: drop one of its files and
+        # poison an epoch=1 file so recomputation would be visible
+        victim = os.path.join(out, "epoch=2-cifar10.val.features.npy")
+        os.remove(victim)
+        sentinel_path = os.path.join(out, "epoch=1-cifar10.train.features.npy")
+        sentinel = np.full((2, 2), 7.0, np.float32)
+        np.save(sentinel_path, sentinel)
+
+        written = save_features_main(args + ["experiment.resume=true"])
+        assert os.path.exists(victim)  # epoch=2 re-exported
+        np.testing.assert_array_equal(np.load(sentinel_path), sentinel)  # skipped
+        # the returned manifest still lists every expected file
+        assert len([p for p in written if "epoch=1-" in os.path.basename(p)]) == 5
+        assert len([p for p in written if "epoch=2-" in os.path.basename(p)]) == 5
 
 
 class TestSupervised:

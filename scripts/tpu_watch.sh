@@ -139,15 +139,18 @@ run_stage() {
             rc=$? ;;
         train_smoke)
             # ~2-minute REAL training run on the chip: synthetic data,
-            # eval_every centroid monitor — regenerates end-to-end on-TPU
-            # learning/monitor evidence, not just step timings (VERDICT r3
-            # item 7). Checkpoints land in /tmp, away from the repo.
+            # eval_every centroid monitor, plus a steady-state profiler
+            # trace (StepTraceWindow) into docs/trace_r4 — the raw-trace
+            # side of the MFU attribution evidence (VERDICT r3 items 2,7).
+            # Checkpoints land in /tmp, away from the repo.
             flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
                 timeout "$(stage_timeout 1200)" python -m simclr_tpu.main \
                 parameter.epochs=4 parameter.warmup_epochs=1 \
                 parameter.num_workers=2 experiment.synthetic_data=true \
                 experiment.synthetic_size=4096 experiment.eval_every=2 \
                 experiment.save_model_epoch=1000 \
+                experiment.profile_dir=docs/trace_r4 \
+                experiment.profile_steps=6 \
                 experiment.save_dir=/tmp/tpu_watch_smoke >> "$LOG" 2>&1
             rc=$? ;;
         remat2048)

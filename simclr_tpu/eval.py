@@ -56,6 +56,7 @@ from simclr_tpu.parallel.mesh import (
 )
 from simclr_tpu.parallel.steps import make_encode_step
 from simclr_tpu.utils.checkpoint import list_checkpoints_or_raise, restore_checkpoint
+from simclr_tpu.utils.ioutil import atomic_write
 from simclr_tpu.utils.logging import get_logger, is_logging_host
 from simclr_tpu.utils.schedule import calculate_initial_lr
 
@@ -459,17 +460,30 @@ def run_eval(cfg: Config) -> dict:
         try:
             with open(results_path) as f:
                 classification_results = json.load(f)
-        except ValueError as exc:
-            # a corrupt results file must not silently turn "resume" into
+            if not isinstance(classification_results, dict):
+                # valid JSON but not a results blob (null, list, string):
+                # same recovery as unparseable content
+                raise ValueError(
+                    f"expected a JSON object, got {type(classification_results).__name__}"
+                )
+        except (ValueError, FileNotFoundError) as exc:
+            # A corrupt results file must not silently turn "resume" into
             # "redo everything and overwrite the evidence": say why, and
-            # set the original aside before the first persist() replaces it
+            # set the original aside before the first persist() replaces
+            # it. FileNotFoundError covers a shared-FS race where another
+            # process's recovery renamed the file between our exists() and
+            # open(); other I/O errors (EIO, EACCES) propagate loudly —
+            # they are operator problems, not corruption.
             logger.warning(
-                "could not parse %s (%s); starting the sweep fresh — the "
+                "could not use %s (%s); starting the sweep fresh — any "
                 "unparseable file is kept at %s.corrupt",
                 results_path, exc, results_path,
             )
             if is_logging_host():
-                os.replace(results_path, results_path + ".corrupt")
+                try:
+                    os.replace(results_path, results_path + ".corrupt")
+                except FileNotFoundError:
+                    pass  # already renamed by a concurrent recovery
             classification_results = {}
         if classification_results:
             logger.info(
@@ -480,10 +494,9 @@ def run_eval(cfg: Config) -> dict:
     def persist() -> None:
         if is_logging_host():
             os.makedirs(save_dir, exist_ok=True)
-            tmp = results_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(classification_results, f)
-            os.replace(tmp, results_path)
+            atomic_write(
+                results_path, lambda f: json.dump(classification_results, f)
+            )
 
     for ckpt in checkpoints:
         key = os.path.basename(ckpt)

@@ -40,6 +40,7 @@ from simclr_tpu.parallel.mesh import (
 )
 from simclr_tpu.parallel.steps import make_augmented_encode_step
 from simclr_tpu.utils.checkpoint import list_checkpoints_or_raise
+from simclr_tpu.utils.ioutil import atomic_write
 from simclr_tpu.utils.logging import get_logger, is_logging_host
 
 logger = get_logger()
@@ -119,10 +120,7 @@ def run_save_features(cfg: Config) -> list[str]:
             # that the resume existence-gate would then carry forward as
             # complete. The file-object form keeps np.save from appending
             # a second .npy suffix to the tmp name.
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                np.save(f, array)
-            os.replace(tmp, path)
+            atomic_write(path, lambda f: np.save(f, array), mode="wb")
         written.append(path)
 
     checkpoints = list_checkpoints_or_raise(str(cfg.experiment.target_dir))

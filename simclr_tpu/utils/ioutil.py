@@ -1,0 +1,30 @@
+"""Crash-safe file writes shared by the entry points.
+
+Every artifact a resume gate later trusts (eval's ``results.json``,
+save_features' ``.npy`` exports) must hit the filesystem atomically: a
+SIGKILL mid-write must leave either the old file or the new one, never a
+truncated hybrid that an existence check would carry forward as complete.
+
+``bench.py``'s ``persist_tpu_capture`` deliberately keeps its own copy of
+this pattern: the bench orchestrator imports no package code at all
+(importing ``simclr_tpu`` pulls jax via ``utils.platform``, and the
+orchestrator must stay jax-free so a hung TPU tunnel cannot hang it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, IO
+
+
+def atomic_write(path: str, write_fn: Callable[[IO], None], mode: str = "w") -> None:
+    """Write via ``write_fn(file)`` to ``path + ".tmp"``, then rename.
+
+    ``mode`` is ``"w"`` for text (json.dump) or ``"wb"`` for binary
+    (np.save). The rename is atomic on POSIX; the tmp file lives in the
+    destination directory so the replace never crosses filesystems.
+    """
+    tmp = path + ".tmp"
+    with open(tmp, mode) as f:
+        write_fn(f)
+    os.replace(tmp, path)

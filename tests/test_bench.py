@@ -189,6 +189,45 @@ def test_capture_provenance_decays_with_age(monkeypatch, tmp_path):
     assert not bench.capture_is_fresh(unknown)
 
 
+def test_stale_capture_restores_patient_probe_budget(monkeypatch, tmp_path):
+    """The orchestrator must PROBE LONGER when the committed capture is
+    stale (prior_round): re-measuring beats re-emitting last round's
+    number. Fresh capture -> short budget; stale -> the patient
+    no-capture budget."""
+    import json
+    import time
+
+    import bench
+
+    path = tmp_path / "BENCH_TPU_CAPTURE.json"
+    monkeypatch.setattr(bench, "TPU_CAPTURE_PATH", str(path))
+    monkeypatch.delenv("BENCH_PROBE_BUDGET_S", raising=False)
+    monkeypatch.setattr(bench, "_acquire_chip_lock", lambda *_: object())
+
+    seen = {}
+
+    def fake_probe(budget_s, interval_s):
+        seen["budget"] = budget_s
+        return False  # tunnel down -> fall through to capture/CPU
+
+    monkeypatch.setattr(bench, "probe_tpu", fake_probe)
+    monkeypatch.setattr(bench, "_run_measurement", lambda *a, **k: None)
+
+    good = {"metric": "pretrain_imgs_per_sec_per_chip", "value": 1.0,
+            "unit": "imgs/sec/chip", "backend": "tpu"}
+
+    bench.persist_tpu_capture(good)  # fresh (now)
+    bench.main()
+    assert seen["budget"] == bench.PROBE_BUDGET_WITH_CAPTURE_S
+
+    old = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() - 48 * 3600)
+    )
+    path.write_text(json.dumps({"captured_at": old, "payload": good}))
+    bench.main()
+    assert seen["budget"] == bench.PROBE_BUDGET_NO_CAPTURE_S
+
+
 def test_timeout_salvages_pre_hang_measurement(monkeypatch):
     """A variant that hangs after an earlier variant succeeded must not lose
     the earlier measurement: the worker prints best-so-far after every

@@ -176,6 +176,28 @@ class TestEval:
         with open(path) as f:
             assert json.load(f).keys() == resumed.keys()
 
+    @pytest.mark.parametrize("content", ["null", '{"trunca'])
+    def test_resume_recovers_from_corrupt_results_file(self, pretrain_run,
+                                                       tmp_path, content):
+        """A results file that parses but is not a dict (null) or does not
+        parse at all (truncated JSON) must not crash resume or be silently
+        overwritten: it is set aside as .corrupt and the sweep restarts."""
+        out = str(tmp_path / "eval-corrupt")
+        args = SYNTH + [
+            "parameter.classifier=centroid",
+            f"experiment.target_dir={pretrain_run['save_dir']}",
+            f"experiment.save_dir={out}",
+        ]
+        os.makedirs(out, exist_ok=True)
+        path = os.path.join(out, "results.json")
+        with open(path, "w") as f:
+            f.write(content)
+
+        resumed = eval_main(args + ["experiment.resume=true"])
+        assert set(resumed.keys()) == {"epoch=1-cifar10", "epoch=2-cifar10"}
+        with open(path + ".corrupt") as f:
+            assert f.read() == content  # evidence preserved
+
     @pytest.mark.parametrize("kind", ["linear", "nonlinear"])
     def test_learnable(self, pretrain_run, tmp_path, kind):
         out = str(tmp_path / f"eval-{kind}")

@@ -1,0 +1,61 @@
+"""Summarize a tpu_watch session log into markdown tables.
+
+Parses the JSON lines the evidence stages stream into the watcher log
+(perf_explore / perf_loss_variants / perf_attrib / bench payloads) and
+prints per-stage markdown — the transcription step between a tunnel window
+landing and docs/PERF.md, done mechanically so numbers can't be mistyped.
+
+Usage: python scripts/summarize_perf_log.py [docs/perf_session_r4.log]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def parse(path: str) -> dict[str, list[dict]]:
+    """JSON lines grouped by the stage header they appeared under."""
+    stage = "preamble"
+    groups: dict[str, list[dict]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("--- stage "):
+                stage = line.split()[2]
+            elif line.startswith("{"):
+                try:
+                    groups.setdefault(stage, []).append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return groups
+
+
+def table(rows: list[dict]) -> str:
+    cols: list[str] = []
+    for r in rows:
+        cols += [k for k in r if k not in cols]
+    out = ["| " + " | ".join(cols) + " |", "|" + "---|" * len(cols)]
+    for r in rows:
+        out.append("| " + " | ".join(str(r.get(c, "")) for c in cols) + " |")
+    return "\n".join(out)
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "docs/perf_session_r4.log"
+    groups = parse(path)
+    if not groups:
+        print(f"no JSON lines found in {path}")
+        return
+    for stage, rows in groups.items():
+        print(f"\n## {stage} ({len(rows)} line(s))\n")
+        flat = [r for r in rows if not any(isinstance(v, dict) for v in r.values())]
+        nested = [r for r in rows if r not in flat]
+        if flat:
+            print(table(flat))
+        for r in nested:  # e.g. perf_attrib's attribution summary
+            print(f"\n```json\n{json.dumps(r, indent=1)}\n```")
+
+
+if __name__ == "__main__":
+    main()

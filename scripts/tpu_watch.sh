@@ -207,7 +207,11 @@ all_done() {
 # stage failure re-probes — dead tunnel aborts the window, a live one
 # continues so a single broken stage can't forfeit the rest.
 collect_window() {
-    echo "=== tunnel alive $(date -u +%FT%TZ); collecting (missing-first) ===" >> "$LOG"
+    # loadavg note: stage dispatch shares ONE host core with anything else
+    # running (e.g. a pytest suite); a high load here flags that this
+    # window's host-side timings may be contended — interpret accordingly
+    echo "=== tunnel alive $(date -u +%FT%TZ); collecting (missing-first);" \
+         "loadavg $(cut -d' ' -f1-3 /proc/loadavg 2>/dev/null || echo '?') ===" >> "$LOG"
     local s deferred=""
     for s in $STAGES; do
         [ "$(date +%s)" -ge "$DEADLINE" ] && return 1

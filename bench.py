@@ -13,12 +13,14 @@ line:
      "imgs/sec/chip", "vs_baseline": ..., "backend": "tpu"|"cpu", ...}
 
 ``vs_baseline``: the reference publishes NO throughput numbers (SURVEY §6 —
-its README tables are accuracy-only), so the denominator is an *estimate* of
-the reference stack's per-GPU rate for this exact workload (PyTorch DDP
-ResNet-18, CIFAR batch 512/GPU, two forward passes + NT-Xent) on a V100:
-~4000 imgs/sec/GPU. vs_baseline > 1 means one TPU chip outruns one reference
-GPU on the same recipe. The emitted JSON carries ``baseline_estimated: true``
-so downstream consumers see the caveat without reading this docstring.
+its README tables are accuracy-only), so the denominator is an ANALYTIC
+CEILING rather than an estimate (VERDICT r4 weak-item 3): the reference
+stack is eager float32 PyTorch DDP — no autocast/GradScaler anywhere in
+``/root/reference`` — so one V100 cannot exceed its 15.7 TFLOP/s fp32 peak
+divided by this recipe's per-image FLOPs (XLA cost analysis of the full
+step). vs_baseline > 1 against that perfect-MFU bound means one TPU chip
+PROVABLY outruns one reference GPU; the emitted JSON carries
+``baseline_kind: analytic_v100_fp32_ceiling`` and the bound itself.
 
 Robustness contract (VERDICT round 1, item 1): this script NEVER exits
 nonzero and NEVER prints a traceback as its last line. The TPU tunnel in
@@ -58,7 +60,58 @@ import time
 PER_DEVICE_BATCH = 512  # reference conf/experiment/cifar10.yaml:10
 WARMUP_STEPS = 10
 TIMED_STEPS = 200
-REFERENCE_GPU_IMGS_PER_SEC = 4000.0  # estimated; see module docstring
+# Baseline denominator (module docstring + BASELINE.md): analytic V100 fp32
+# ceiling for the reference's eager-fp32-DDP stack. The fallback per-image
+# FLOPs come from the committed capture's XLA cost analysis (2.988 TFLOP /
+# step / 512 images); a live measurement recomputes from its own program.
+V100_FP32_PEAK_TFLOPS = 15.7  # NVIDIA V100 SXM2 datasheet, fp32
+FALLBACK_TFLOP_PER_IMAGE = 2.988 / 512  # BENCH_TPU_CAPTURE.json cost analysis
+
+
+def apply_baseline(payload: dict) -> None:
+    """Stamp vs_baseline + provenance onto a measurement payload in place.
+
+    Uses the payload's own cost-analysis FLOPs when present so the bound
+    always matches the measured program; the committed capture's per-image
+    FLOPs otherwise.
+    """
+    tflop_per_step = payload.get("tflop_per_step_per_chip")
+    batch = payload.get("per_device_batch")
+    tflop_per_image = (
+        tflop_per_step / batch if tflop_per_step and batch else FALLBACK_TFLOP_PER_IMAGE
+    )
+    bound = V100_FP32_PEAK_TFLOPS / tflop_per_image
+    payload["vs_baseline"] = round(payload.get("value", 0.0) / bound, 3)
+    payload["baseline_estimated"] = False
+    payload["baseline_kind"] = "analytic_v100_fp32_ceiling"
+    payload["baseline_bound_imgs_per_sec"] = round(bound, 1)
+    payload["baseline_note"] = (
+        "reference publishes no throughput; denominator is the perfect-MFU "
+        "ceiling of its stack (eager fp32 PyTorch DDP, no AMP in "
+        "/root/reference): V100 fp32 peak 15.7 TFLOP/s over "
+        f"{tflop_per_image * 1000:.2f} GFLOP/image (XLA cost analysis of "
+        "this recipe), so vs_baseline is a LOWER bound on the per-chip "
+        "speedup (BASELINE.md)"
+    )
+
+def last_ditch_payload(exc: BaseException) -> dict:
+    """The orchestrator-crash payload, carrying the same baseline provenance
+    contract as every measured payload (apply_baseline is pure arithmetic,
+    but this path must NEVER throw — hence the guard)."""
+    payload = {
+        "metric": "pretrain_imgs_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "imgs/sec/chip",
+        "vs_baseline": 0.0,
+        "backend": "none",
+        "error": repr(exc),
+    }
+    try:
+        apply_baseline(payload)
+    except Exception:  # pragma: no cover — contract keeper
+        pass
+    return payload
+
 
 PROBE_TIMEOUT_S = 150  # first TPU compile through the tunnel is ~20-40s
 PROBE_INTERVAL_S = 120  # sleep between failed probes (outages are long)
@@ -135,15 +188,22 @@ def _capture_age_hours(captured_at: str):
 
     ``calendar.timegm`` (not ``time.mktime``) keeps the comparison
     timezone- and DST-independent: the stamp is UTC and the freshness
-    boundary must not wobble by the host's DST offset.
+    boundary must not wobble by the host's DST offset. A stamp more than
+    a few minutes in the FUTURE (clock skew, hand-edited file) is treated
+    like an unparseable one (ADVICE r4): returning a clamped 0.0 would
+    label the capture "in_round" indefinitely and pin the short probe
+    budget forever; None decays it to prior_round instead.
     """
     import calendar
 
     try:
         t = calendar.timegm(time.strptime(captured_at, "%Y-%m-%dT%H:%M:%SZ"))
-        return max((time.time() - t) / 3600.0, 0.0)
     except (TypeError, ValueError):
         return None
+    age_h = (time.time() - t) / 3600.0
+    if age_h < -0.1:  # >6 min in the future: not a trustworthy stamp
+        return None
+    return max(age_h, 0.0)
 
 
 def load_tpu_capture():
@@ -397,16 +457,12 @@ def worker(backend: str) -> None:
             "metric": "pretrain_imgs_per_sec_per_chip",
             "value": per_chip,
             "unit": "imgs/sec/chip",
-            "vs_baseline": round(per_chip / REFERENCE_GPU_IMGS_PER_SEC, 3),
             "backend": jax.default_backend(),
             "n_chips": n_chips,
             "per_device_batch": per_device_batch,
             "timed_steps": timed_steps,
             "variant": best_name,
             "variant_rates": rates,
-            "baseline_estimated": True,
-            "baseline_note": "denominator 4000 imgs/sec is an estimated "
-            "V100 rate; reference publishes no throughput (SURVEY §6)",
         }
         flops = flops_per_step.get(best_name, 0.0)
         if flops:
@@ -421,6 +477,7 @@ def worker(backend: str) -> None:
             )
         if errors:
             payload["variant_errors"] = errors
+        apply_baseline(payload)
         print(json.dumps(payload), flush=True)
 
     rates, flops_per_step, errors = {}, {}, {}
@@ -522,9 +579,12 @@ def main() -> None:
             "unit": "imgs/sec/chip",
             "vs_baseline": 0.0,
             "backend": "none",
-            "baseline_estimated": True,
             "error": "both TPU and CPU measurements failed; see stderr",
         }
+    # (re-)stamp the baseline fields: a re-emitted capture or error payload
+    # must carry the CURRENT denominator derivation, not the one persisted
+    # when the capture was taken
+    apply_baseline(result)
     print(json.dumps(result))
 
 
@@ -541,16 +601,6 @@ if __name__ == "__main__":
     except Exception as exc:  # pragma: no cover — last-ditch contract keeper
         print(f"# unexpected orchestrator error: {exc!r}", file=sys.stderr)
         print(
-            json.dumps(
-                {
-                    "metric": "pretrain_imgs_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "imgs/sec/chip",
-                    "vs_baseline": 0.0,
-                    "backend": "none",
-                    "baseline_estimated": True,
-                    "error": repr(exc),
-                }
-            )
+            json.dumps(last_ditch_payload(exc))
         )
     sys.exit(0)

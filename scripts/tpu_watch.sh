@@ -70,10 +70,24 @@ fi
 # which takes the same lock (bench._acquire_chip_lock), serializes
 # against stages instead of measuring a contended chip or waiting out
 # the watcher's whole lifetime. -E 201 gives contention a distinct exit
-# code so it is never booked as stage breakage.
+# code; because a stage child could itself exit 201, a lock-acquired
+# sentinel disambiguates (ADVICE r4): the sentinel is written only
+# after flock grants the lock, so rc=201 WITH the sentinel present is
+# the stage's own exit status and counts as a failure.
 CHIP_LOCK="${TPU_WATCH_LOCK:-/tmp/tpu_watch.lock}"
 CHIP_LOCK_WAIT="${TPU_WATCH_LOCK_WAIT:-1800}"
 LOCK_CONFLICT_RC=201
+LOCK_SENTINEL="$STATE/.lock_acquired"
+
+# run_locked <timeout_s> <cmd...>: chip-locked stage execution. The
+# wrapper touches the sentinel strictly after lock acquisition, then
+# execs `timeout <timeout_s> <cmd...>`.
+run_locked() {
+    local t="$1"; shift
+    rm -f "$LOCK_SENTINEL"
+    flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
+        bash -c 'touch "$1"; shift; exec timeout "$@"' _ "$LOCK_SENTINEL" "$t" "$@"
+}
 
 # Probe timeout: one definition — bench.py's PROBE_TIMEOUT_S (ADVICE r3:
 # a 100s probe misclassifies a live-but-slow revival bench.py would have
@@ -128,13 +142,11 @@ run_stage() {
     echo "--- stage $name $(date -u +%FT%TZ) ---" >> "$LOG"
     case "$name" in
         loss_variants)
-            flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
-                timeout "$(stage_timeout 1500)" python scripts/perf_loss_variants.py \
+            run_locked "$(stage_timeout 1500)" python scripts/perf_loss_variants.py \
                 --steps 100 --batches 512,1024,2048,4096 >> "$LOG" 2>&1
             rc=$? ;;
         attrib512)
-            flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
-                timeout "$(stage_timeout 1200)" python scripts/perf_attrib.py \
+            run_locked "$(stage_timeout 1200)" python scripts/perf_attrib.py \
                 --steps 50 --batch 512 >> "$LOG" 2>&1
             rc=$? ;;
         train_smoke)
@@ -143,8 +155,7 @@ run_stage() {
             # trace (StepTraceWindow) into docs/trace_r4 — the raw-trace
             # side of the MFU attribution evidence (VERDICT r3 items 2,7).
             # Checkpoints land in /tmp, away from the repo.
-            flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
-                timeout "$(stage_timeout 1200)" python -m simclr_tpu.main \
+            run_locked "$(stage_timeout 1200)" python -m simclr_tpu.main \
                 parameter.epochs=4 parameter.warmup_epochs=1 \
                 parameter.num_workers=2 experiment.synthetic_data=true \
                 experiment.synthetic_size=4096 experiment.eval_every=2 \
@@ -154,18 +165,15 @@ run_stage() {
                 experiment.save_dir=/tmp/tpu_watch_smoke >> "$LOG" 2>&1
             rc=$? ;;
         remat2048)
-            flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
-                timeout "$(stage_timeout 1200)" python scripts/perf_explore.py \
+            run_locked "$(stage_timeout 1200)" python scripts/perf_explore.py \
                 --steps 30 --batch 2048 --variants two_pass_remat >> "$LOG" 2>&1
             rc=$? ;;
         explore512)
-            flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
-                timeout "$(stage_timeout 1800)" python scripts/perf_explore.py \
+            run_locked "$(stage_timeout 1800)" python scripts/perf_explore.py \
                 --steps 100 --batch 512 >> "$LOG" 2>&1
             rc=$? ;;
         explore1024)
-            flock -w "$CHIP_LOCK_WAIT" -E "$LOCK_CONFLICT_RC" "$CHIP_LOCK" \
-                timeout "$(stage_timeout 1200)" python scripts/perf_explore.py \
+            run_locked "$(stage_timeout 1200)" python scripts/perf_explore.py \
                 --steps 50 --batch 1024 >> "$LOG" 2>&1
             rc=$? ;;
         bench)
@@ -185,8 +193,10 @@ run_stage() {
         echo "--- stage $name DONE ---" >> "$LOG"
         return 0
     fi
-    if [ "$rc" -eq "$LOCK_CONFLICT_RC" ]; then
-        # chip lock contention (driver bench running): not stage breakage
+    if [ "$rc" -eq "$LOCK_CONFLICT_RC" ] && [ ! -f "$LOCK_SENTINEL" ]; then
+        # chip lock contention (driver bench running): not stage breakage.
+        # Sentinel present would mean the lock WAS acquired and the stage
+        # itself exited 201 — that falls through to the failure path.
         echo "--- stage $name LOCK-CONTENDED (not counted as failure) ---" >> "$LOG"
         return 1
     fi

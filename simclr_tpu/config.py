@@ -278,6 +278,88 @@ def resolve_save_dir(cfg: Config, now: datetime.datetime | None = None) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Multirun sweeps — the reference's Hydra sweep surface
+# (``/root/reference/conf/hydra/output/custom.yaml:6-8``: ``hydra.sweep.dir``
+# + job-number subdirs). ``--multirun`` / ``-m`` on any entry point expands
+# comma-list overrides into the cartesian product of jobs, each writing to
+# ``<sweep_root>/<job_idx>``.
+# ---------------------------------------------------------------------------
+
+MULTIRUN_FLAGS = ("--multirun", "-m")
+
+
+def split_multirun_flag(argv: list[str]) -> tuple[bool, list[str]]:
+    """Strip Hydra's multirun flag from an argv-style override list."""
+    multirun = any(a in MULTIRUN_FLAGS for a in argv)
+    return multirun, [a for a in argv if a not in MULTIRUN_FLAGS]
+
+
+def expand_sweep(argv: list[str]) -> list[list[str]]:
+    """Expand ``key=v1,v2`` comma-list overrides into single-run combos.
+
+    Mirrors Hydra's multirun semantics: every comma-listed override
+    contributes one axis, and jobs are the cartesian product in argv order.
+    A bracketed value (``key=[a,b]``) is one YAML list, not a sweep axis.
+    """
+    import itertools
+
+    axes: list[list[str]] = []
+    for arg in argv:
+        if "=" not in arg:
+            raise ConfigError(
+                f"override {arg!r} must look like key=value (e.g. parameter.epochs=200)"
+            )
+        key, raw = arg.split("=", 1)
+        if "," in raw and not raw.strip().startswith("["):
+            values = [v.strip() for v in raw.split(",")]
+            if any(not v for v in values):
+                raise ConfigError(f"empty value in sweep override {arg!r}")
+            axes.append([f"{key}={v}" for v in values])
+        else:
+            axes.append([arg])
+    return [list(combo) for combo in itertools.product(*axes)]
+
+
+def run_multirun(run_fn, config_name: str, argv: list[str]) -> list:
+    """Run ``run_fn(cfg)`` once per sweep job, sequentially.
+
+    Every job writes under one sweep root in a ``<job_idx>`` subdir, the
+    analogue of Hydra's ``hydra.sweep.dir``/``subdir`` layout. The root is
+    an explicit ``experiment.save_dir`` when given; otherwise a NEUTRAL
+    dated ``results/multirun/...`` dir — job 0's own resolved save dir
+    would encode job 0's name/seed in the path and misattribute the other
+    jobs' results (e.g. a ``parameter.seed=3,5`` sweep filing seed-5 under
+    ``seed-3/``). Returns the per-job results in job order.
+    """
+    import logging
+
+    combos = expand_sweep(argv)
+    sweep_root: str | None = None
+    results = []
+    for i, combo in enumerate(combos):
+        cfg = load_config(config_name, overrides=combo)
+        if sweep_root is None:
+            explicit = cfg.select("experiment.save_dir")
+            if explicit:
+                sweep_root = str(explicit)
+            else:
+                now = datetime.datetime.now()
+                sweep_root = os.path.join(
+                    "results", "multirun",
+                    now.strftime("%Y-%m-%d"), now.strftime("%H-%M-%S"),
+                )
+        cfg.update_dotted(
+            "experiment.save_dir", os.path.join(sweep_root, str(i)), allow_new=True
+        )
+        logging.getLogger("simclr_tpu").info(
+            "multirun job %d/%d: %s -> %s", i + 1, len(combos),
+            " ".join(combo) or "<defaults>", cfg.experiment.save_dir,
+        )
+        results.append(run_fn(cfg))
+    return results
+
+
+# ---------------------------------------------------------------------------
 # Startup validation — the reference's hand-rolled asserts, kept as explicit
 # contracts (main.py:39-50, eval.py:20-28, supervised.py:18-27,
 # save_features.py:15-17 in /root/reference).

@@ -34,6 +34,10 @@ _TEST_SIZES = {"cifar10": 10000, "cifar100": 10000}
 
 DEFAULT_DATA_DIR = os.environ.get("SIMCLR_DATA_DIR", os.path.expanduser("~/data"))
 
+# per-pixel Gaussian sigma of the synthetic fallback when unspecified —
+# the single source the yaml comments ("null -> 24") refer to
+DEFAULT_SYNTHETIC_NOISE = 24.0
+
 
 @dataclass(frozen=True)
 class Dataset:
@@ -95,13 +99,19 @@ def _load_cifar100(data_dir: str, split: str) -> tuple[np.ndarray, np.ndarray]:
 
 
 def synthetic_dataset(
-    name: str, split: str, size: int | None = None, seed: int = 0
+    name: str, split: str, size: int | None = None, seed: int = 0,
+    noise: float | None = None,
 ) -> Dataset:
     """Deterministic class-conditional fake CIFAR (same shapes/dtypes).
 
     Each class gets a fixed random 32x32x3 prototype; samples are the
     prototype plus pixel noise — enough structure that probes beat chance and
     training loss visibly falls, so end-to-end plumbing is testable.
+
+    ``noise`` is the per-pixel Gaussian sigma. The default 24 keeps classes
+    nearly separable in pixel space (smoke tests); convergence runs raise it
+    (e.g. 96) so a RANDOM-init encoder probes poorly and the gap to the
+    trained encoder demonstrates learning, not just data separability.
     """
     num_classes = NUM_CLASSES[name]
     if size is None:
@@ -117,10 +127,10 @@ def synthetic_dataset(
         np.float32
     )
     labels = np.arange(size, dtype=np.int32) % num_classes
-    noise = noise_rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
-    noise *= 24.0
-    noise += prototypes[labels]
-    images = np.clip(noise, 0, 255, out=noise).astype(np.uint8)
+    pixels = noise_rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
+    pixels *= DEFAULT_SYNTHETIC_NOISE if noise is None else float(noise)
+    pixels += prototypes[labels]
+    images = np.clip(pixels, 0, 255, out=pixels).astype(np.uint8)
     return Dataset(images=images, labels=labels, name=name, split=split, synthetic=True)
 
 
@@ -130,6 +140,7 @@ def load_dataset(
     data_dir: str | None = None,
     synthetic_ok: bool = False,
     synthetic_size: int | None = None,
+    synthetic_noise: float | None = None,
 ) -> Dataset:
     """Load a CIFAR split from disk, optionally falling back to synthetic.
 
@@ -153,4 +164,6 @@ def load_dataset(
                 f"synthetic_ok=True (experiment.synthetic_data=true) for a "
                 f"deterministic synthetic stand-in"
             ) from None
-        return synthetic_dataset(name, split, size=synthetic_size)
+        return synthetic_dataset(
+            name, split, size=synthetic_size, noise=synthetic_noise,
+        )

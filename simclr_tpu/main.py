@@ -94,6 +94,7 @@ def run_pretrain(cfg: Config) -> dict:
         data_dir=cfg.select("experiment.data_dir"),
         synthetic_ok=bool(cfg.select("experiment.synthetic_data", False)),
         synthetic_size=cfg.select("experiment.synthetic_size"),
+        synthetic_noise=cfg.select("experiment.synthetic_noise"),
     )
 
     # Reference step accounting (drop_last truncation, main.py:76-80)
@@ -247,12 +248,20 @@ def run_pretrain(cfg: Config) -> dict:
     # default for recipe parity.
     eval_every = int(cfg.select("experiment.eval_every", 0) or 0)
     monitor_val_acc = None
+    # per-epoch evidence curves (loss always; monitor when eval_every>0) as
+    # [epoch, value] pairs — self-describing under resume, where the run
+    # covers start_epoch..epochs only. Persisted to
+    # <save_dir>/pretrain_results.json so a long run leaves a committable
+    # learning artifact, not just a final scalar.
+    loss_history: list[list[float]] = []
+    monitor_history: list[list[float]] = []
     if eval_every > 0:
         test_ds = load_dataset(
             cfg.experiment.name, "test",
             data_dir=cfg.select("experiment.data_dir"),
             synthetic_ok=bool(cfg.select("experiment.synthetic_data", False)),
             synthetic_size=cfg.select("experiment.synthetic_size"),
+            synthetic_noise=cfg.select("experiment.synthetic_noise"),
         )
         # on-device reshard to replicated: the encode program expects
         # replicated variables, and a TP run's live head leaves are
@@ -291,6 +300,11 @@ def run_pretrain(cfg: Config) -> dict:
                     epoch, res["val_acc"], res["val_top_5_acc"],
                 )
             return res["val_acc"]
+    if eval_every > 0 and start_epoch == 1:
+        # epoch-0 probe: the RANDOM-INIT accuracy anchors the monitor curve,
+        # so a later reader can tell learned features from data that is
+        # already separable to an untrained encoder
+        monitor_history.append([0, run_monitor_probe(0)])
     # host-side step counter: reading state.step off-device every iteration
     # would sync the host to the in-flight step and kill async dispatch
     cur_step = (start_epoch - 1) * steps_per_epoch
@@ -339,9 +353,11 @@ def run_pretrain(cfg: Config) -> dict:
                 epoch, epochs, epoch / epochs, float(metrics["loss"]), lr_now,
                 imgs_per_sec,
             )
+        loss_history.append([epoch, float(metrics["loss"])])
         if eval_every > 0 and (epoch % eval_every == 0 or epoch == epochs):
             timer.pause(metrics["loss"])  # keep probe compute out of imgs/sec
             monitor_val_acc = run_monitor_probe(epoch)
+            monitor_history.append([epoch, monitor_val_acc])
             timer.resume()
         if epoch % save_model_epoch == 0 or epoch == epochs:
             path = os.path.join(
@@ -371,18 +387,33 @@ def run_pretrain(cfg: Config) -> dict:
         "lr0": lr0,
         "imgs_per_sec_steady": throughput["imgs_per_sec"],
     }
+    summary["loss_history"] = loss_history
     if monitor_val_acc is not None:
         summary["monitor_val_acc"] = monitor_val_acc
+        summary["monitor_history"] = monitor_history
+    if is_logging_host():
+        import json
+
+        from simclr_tpu.utils.ioutil import atomic_write
+
+        atomic_write(
+            os.path.join(save_dir, "pretrain_results.json"),
+            lambda f: json.dump(summary, f, indent=1),
+        )
     return summary
 
 
-def main(argv: list[str] | None = None) -> dict:
+def main(argv: list[str] | None = None):
+    from simclr_tpu.config import run_multirun, split_multirun_flag
     from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
     maybe_initialize_multihost()
-    cfg = load_config("config", overrides=list(sys.argv[1:] if argv is None else argv))
+    multirun, args = split_multirun_flag(list(sys.argv[1:] if argv is None else argv))
+    if multirun:
+        return run_multirun(run_pretrain, "config", args)
+    cfg = load_config("config", overrides=args)
     return run_pretrain(cfg)
 
 

@@ -96,10 +96,12 @@ def run_save_features(cfg: Config) -> list[str]:
     train_ds = load_dataset(
         cfg.experiment.name, "train", data_dir=data_dir, synthetic_ok=synthetic_ok,
         synthetic_size=cfg.select("experiment.synthetic_size"),
+        synthetic_noise=cfg.select("experiment.synthetic_noise"),
     )
     val_ds = load_dataset(
         cfg.experiment.name, "test", data_dir=data_dir, synthetic_ok=synthetic_ok,
         synthetic_size=cfg.select("experiment.synthetic_size"),
+        synthetic_noise=cfg.select("experiment.synthetic_noise"),
     )
 
     model = build_eval_model(cfg)
@@ -173,13 +175,18 @@ def run_save_features(cfg: Config) -> list[str]:
     return written
 
 
-def main(argv: list[str] | None = None) -> list[str]:
+def main(argv: list[str] | None = None):
     from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
     maybe_initialize_multihost()
-    cfg = load_config("eval", overrides=list(sys.argv[1:] if argv is None else argv))
+    from simclr_tpu.config import run_multirun, split_multirun_flag
+
+    multirun, args = split_multirun_flag(list(sys.argv[1:] if argv is None else argv))
+    if multirun:
+        return run_multirun(run_save_features, "eval", args)
+    cfg = load_config("eval", overrides=args)
     return run_save_features(cfg)
 
 

@@ -88,10 +88,12 @@ def run_supervised(cfg: Config) -> dict:
     train_ds = load_dataset(
         cfg.experiment.name, "train", data_dir=data_dir, synthetic_ok=synthetic_ok,
         synthetic_size=cfg.select("experiment.synthetic_size"),
+        synthetic_noise=cfg.select("experiment.synthetic_noise"),
     )
     val_ds = load_dataset(
         cfg.experiment.name, "test", data_dir=data_dir, synthetic_ok=synthetic_ok,
         synthetic_size=cfg.select("experiment.synthetic_size"),
+        synthetic_noise=cfg.select("experiment.synthetic_noise"),
     )
     num_classes = NUM_CLASSES[cfg.experiment.name]
 
@@ -325,7 +327,7 @@ def run_supervised(cfg: Config) -> dict:
             throughput["imgs_per_sec"], throughput["imgs_per_sec_per_chip"],
             timed_steps,
         )
-    return {
+    summary = {
         "imgs_per_sec_steady": throughput["imgs_per_sec"],
         "best_epoch": best_epoch,
         "best_value": best_value,
@@ -335,17 +337,30 @@ def run_supervised(cfg: Config) -> dict:
         "save_dir": save_dir,
         "steps": int(state.step),
     }
+    if is_logging_host():
+        import json
+
+        from simclr_tpu.utils.ioutil import atomic_write
+
+        atomic_write(
+            os.path.join(save_dir, "supervised_results.json"),
+            lambda f: json.dump(summary, f, indent=1),
+        )
+    return summary
 
 
-def main(argv: list[str] | None = None) -> dict:
+def main(argv: list[str] | None = None):
     from simclr_tpu.parallel.multihost import maybe_initialize_multihost
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
     maybe_initialize_multihost()
-    cfg = load_config(
-        "supervised_config", overrides=list(sys.argv[1:] if argv is None else argv)
-    )
+    from simclr_tpu.config import run_multirun, split_multirun_flag
+
+    multirun, args = split_multirun_flag(list(sys.argv[1:] if argv is None else argv))
+    if multirun:
+        return run_multirun(run_supervised, "supervised_config", args)
+    cfg = load_config("supervised_config", overrides=args)
     return run_supervised(cfg)
 
 

@@ -14,17 +14,39 @@ orchestrator must stay jax-free so a hung TPU tunnel cannot hang it).
 from __future__ import annotations
 
 import os
+import tempfile
 from typing import Callable, IO
 
 
 def atomic_write(path: str, write_fn: Callable[[IO], None], mode: str = "w") -> None:
-    """Write via ``write_fn(file)`` to ``path + ".tmp"``, then rename.
+    """Write via ``write_fn(file)`` to a unique temp file, then rename.
 
     ``mode`` is ``"w"`` for text (json.dump) or ``"wb"`` for binary
     (np.save). The rename is atomic on POSIX; the tmp file lives in the
-    destination directory so the replace never crosses filesystems.
+    destination directory so the replace never crosses filesystems. The
+    tmp name is unique per call (ADVICE r4: a fixed ``path + ".tmp"``
+    lets two concurrent writers corrupt the winner — writer A's open fd
+    keeps writing into the inode writer B renamed into place), and the
+    data is fsynced before the rename so a power loss cannot surface an
+    empty file under the final name.
     """
-    tmp = path + ".tmp"
-    with open(tmp, mode) as f:
-        write_fn(f)
-    os.replace(tmp, path)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", prefix=os.path.basename(path) + ".tmp."
+    )
+    try:
+        # mkstemp creates 0600; restore umask-governed permissions so shared
+        # artifacts (results JSON, feature exports) stay readable as before
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -140,3 +140,63 @@ class TestOverrideMarker:
             ["experiment=cifar10-large-batch", "parameter.linear_schedule=true"],
         )
         assert cfg.parameter.linear_schedule is True
+
+
+def test_expand_sweep_cartesian_product_in_argv_order():
+    from simclr_tpu.config import expand_sweep
+
+    combos = expand_sweep(["a.b=1,2", "c.d=x", "e.f=3,4"])
+    assert combos == [
+        ["a.b=1", "c.d=x", "e.f=3"],
+        ["a.b=1", "c.d=x", "e.f=4"],
+        ["a.b=2", "c.d=x", "e.f=3"],
+        ["a.b=2", "c.d=x", "e.f=4"],
+    ]
+
+
+def test_expand_sweep_bracketed_list_is_one_value():
+    from simclr_tpu.config import expand_sweep
+
+    # a YAML list value is NOT a sweep axis (Hydra semantics)
+    assert expand_sweep(["a.b=[1,2]"]) == [["a.b=[1,2]"]]
+    assert expand_sweep(["a.b=7"]) == [["a.b=7"]]
+
+
+def test_expand_sweep_rejects_empty_values():
+    from simclr_tpu.config import expand_sweep
+
+    with pytest.raises(ConfigError, match="empty value"):
+        expand_sweep(["a.b=1,,2"])
+    with pytest.raises(ConfigError, match="key=value"):
+        expand_sweep(["no-equals-sign"])
+
+
+def test_split_multirun_flag():
+    from simclr_tpu.config import split_multirun_flag
+
+    assert split_multirun_flag(["a=1"]) == (False, ["a=1"])
+    assert split_multirun_flag(["--multirun", "a=1"]) == (True, ["a=1"])
+    assert split_multirun_flag(["a=1", "-m"]) == (True, ["a=1"])
+
+
+def test_run_multirun_layout_and_order(tmp_path):
+    """Jobs share one sweep root with <job_idx> subdirs — the analogue of
+    Hydra's hydra.sweep.dir/subdir layout
+    (/root/reference/conf/hydra/output/custom.yaml:6-8)."""
+    from simclr_tpu.config import run_multirun
+
+    seen = []
+
+    def record(cfg):
+        seen.append((cfg.parameter.seed, cfg.experiment.save_dir))
+        return cfg.parameter.seed
+
+    results = run_multirun(
+        record, "config",
+        [f"experiment.save_dir={tmp_path}/sweep", "parameter.seed=3,5"],
+    )
+    assert results == [3, 5]
+    assert seen == [
+        (3, f"{tmp_path}/sweep/0"),
+        (5, f"{tmp_path}/sweep/1"),
+    ]

@@ -116,7 +116,9 @@ def test_two_process_eval_end_to_end(tmp_path):
     import json
 
     results = json.load(open(results_files[0]))
-    (ckpt_results,) = results.values()
+    (ckpt_results,) = (
+        v for k, v in results.items() if k != "__config__"
+    )
     assert 0.0 <= ckpt_results["val_acc"] <= 1.0
 
 
@@ -165,7 +167,9 @@ def test_two_process_linear_probe_and_save_features(tmp_path):
     import json
 
     (results_file,) = list(eval_dir.rglob("results.json"))
-    (ckpt_results,) = json.load(open(results_file)).values()
+    (ckpt_results,) = (
+        v for k, v in json.load(open(results_file)).items() if k != "__config__"
+    )
     assert len(ckpt_results["val_accuracies"]) == 2
     assert all(0.0 <= a <= 1.0 for a in ckpt_results["val_accuracies"])
 

@@ -206,6 +206,23 @@ def test_lock_contention_is_not_stage_failure(tmp_path):
         assert s not in _done(state), s
 
 
+def test_stage_exit_201_is_failure_not_contention(tmp_path):
+    """ADVICE r4: a stage child that itself exits 201 (flock's contention
+    code) must be booked as a stage failure — the lock-acquired sentinel
+    proves the lock was granted, so 201 is the stage's own exit status."""
+    _write_stub(tmp_path, fail_scripts=("perf_attrib.py",))
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        'case "$*" in *perf_attrib.py*) exit 1;; esac',
+        'case "$*" in *perf_attrib.py*) exit 201;; esac'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert (state / "attrib512.fails").read_text().strip() == "1"
+    text = log.read_text()
+    assert "stage attrib512 FAILED" in text
+    assert "attrib512 LOCK-CONTENDED" not in text
+    assert "attrib512" not in _done(state)
+
+
 def test_hung_stage_releases_lock_and_dead_reprobe_aborts(tmp_path):
     """VERDICT r3 item 8 — the failure mode round 3 actually hit: a stage
     starts under a live probe, hangs until its timeout fires, and the tunnel

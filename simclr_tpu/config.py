@@ -343,6 +343,16 @@ def run_multirun(run_fn, config_name: str, argv: list[str]) -> list:
             if explicit:
                 sweep_root = str(explicit)
             else:
+                import jax
+
+                if jax.process_count() > 1:
+                    raise ConfigError(
+                        "multirun without an explicit experiment.save_dir is "
+                        "not multi-process safe: each process would compute "
+                        "its own dated sweep root and the ranks would "
+                        "desynchronize; set experiment.save_dir to a shared "
+                        "directory"
+                    )
                 now = datetime.datetime.now()
                 sweep_root = os.path.join(
                     "results", "multirun",

@@ -443,6 +443,13 @@ def sweep_fingerprint(cfg: Config) -> dict:
         "dataset": str(cfg.experiment.name),
         "base_cnn": str(cfg.experiment.base_cnn),
         "d": int(cfg.parameter.d),
+        # which model's checkpoints and which data the numbers describe —
+        # checkpoint entries are keyed by basename, so two target dirs with
+        # the same epoch=N names would otherwise collide silently
+        "target_dir": str(cfg.experiment.target_dir),
+        "synthetic_data": bool(cfg.select("experiment.synthetic_data", False)),
+        "synthetic_size": cfg.select("experiment.synthetic_size"),
+        "synthetic_noise": cfg.select("experiment.synthetic_noise"),
     }
 
 

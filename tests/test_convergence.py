@@ -1,0 +1,82 @@
+"""Learning-convergence gates (VERDICT r4 item 1, missing-item 1).
+
+Every other test pins parity, shapes, distributions, or SPMD equivalences;
+none would catch an optimizer that silently zeroes updates after the first
+steps, because nothing trains past ~2 tiny epochs. These tests close that
+hole: the FULL pretrain recipe (augment → two forwards → NT-Xent → psum →
+LARS, the same compiled step as production) and the supervised baseline
+must demonstrably LEARN on class-structured synthetic data — loss falling
+and probes climbing from a chance-level random-init anchor.
+
+The data uses ``synthetic_noise=64``: at that sigma a RANDOM-init encoder's
+centroid probe sits at chance (~0.10, measured — see
+``docs/convergence_r5.log``), so above-chance accuracy here is attributable
+to learned features, not to pixel-space separability.
+
+The reference has no analogue of these tests; its de-facto learning
+evidence is the README accuracy table (``/root/reference/README.md:37-56``),
+unreproducible without its 4-GPU × multi-day budget. The committed artifact
+of the same recipe at a longer horizon lives in
+``results/convergence_r5/pretrain_results.json`` (see PARITY.md §Learning).
+"""
+
+import pytest
+
+from simclr_tpu.main import main as pretrain_main
+from simclr_tpu.supervised import main as supervised_main
+
+pytestmark = pytest.mark.slow  # two real multi-epoch training runs
+
+SYNTH = [
+    "experiment.synthetic_data=true",
+    "experiment.synthetic_size=512",
+    "experiment.synthetic_noise=64",
+    "experiment.batches=8",  # x8 devices -> global batch 64, 8 steps/epoch
+    "precision.compute_dtype=float32",  # CPU-mesh run; TPU uses bf16
+]
+
+CHANCE = 0.1  # cifar10 labels
+
+
+def test_pretrain_recipe_learns(tmp_path):
+    """Loss falls from its chance plateau and the centroid monitor climbs
+    from the epoch-0 random-init anchor to >=3x chance."""
+    summary = pretrain_main(
+        SYNTH
+        + [
+            "parameter.epochs=6",
+            "parameter.warmup_epochs=1",
+            "experiment.eval_every=3",
+            "experiment.save_model_epoch=1000",
+            f"experiment.save_dir={tmp_path / 'pretrain'}",
+        ]
+    )
+    monitor = {int(e): a for e, a in summary["monitor_history"]}
+    assert monitor[0] < 2 * CHANCE, f"random-init probe not at chance: {monitor}"
+    final = monitor[6]
+    assert final >= 3 * CHANCE, f"no learning signal: {monitor}"
+    assert final > monitor[0] + 0.15, f"monitor curve not rising: {monitor}"
+
+    losses = [loss for _, loss in summary["loss_history"]]
+    # NT-Xent starts at ~ln(2N-1) (uniform over candidates) and must fall
+    # measurably below it once features cluster
+    assert losses[-1] < losses[0] - 0.2, f"loss did not fall: {losses}"
+    assert all(l > 0 for l in losses)
+
+
+def test_supervised_baseline_learns(tmp_path):
+    """Cross-entropy val accuracy climbs clearly above chance within a few
+    epochs; best-checkpoint bookkeeping tracks the climbing metric."""
+    summary = supervised_main(
+        SYNTH
+        + [
+            "parameter.epochs=3",
+            "parameter.warmup_epochs=1",
+            f"experiment.save_dir={tmp_path / 'sup'}",
+        ]
+    )
+    accs = [h["val_acc"] for h in summary["history"]]
+    assert accs[-1] >= 3 * CHANCE, f"supervised val_acc stuck at chance: {accs}"
+    assert max(accs) == accs[summary["best_epoch"] - 1] or summary[
+        "metric"
+    ] == "loss", summary

@@ -247,12 +247,23 @@ class TestCifarReader:
 
     def test_synthetic_is_class_conditional(self):
         ds = synthetic_dataset("cifar10", "train", size=200)
-        # same-class images correlate more than cross-class
+        # same-class images correlate more than cross-class (shared
+        # prototype vs instance-specific field+texture)
         a = ds.images[ds.labels == 0].astype(np.float32)
         same = np.corrcoef(a[0].ravel(), a[1].ravel())[0, 1]
         b = ds.images[ds.labels == 1].astype(np.float32)
         cross = np.corrcoef(a[0].ravel(), b[0].ravel())[0, 1]
         assert same > cross + 0.2
+        # the instance content is LOW-FREQUENCY (view-stable under crops),
+        # not iid: after removing the shared class prototype, variation
+        # across 4x4 upsample cells must dominate variation within a cell
+        # (iid noise would make them equal — the measured-collapse design
+        # this generator replaced)
+        resid = a[0] - a.mean(0)
+        cells = resid.reshape(8, 4, 8, 4, 3)
+        within_cell = cells.std(axis=(1, 3)).mean()
+        across_cells = cells.mean(axis=(1, 3)).std()
+        assert across_cells > 2.0 * within_cell, (across_cells, within_cell)
 
     def test_bad_name_raises(self):
         with pytest.raises(ValueError):

@@ -147,14 +147,16 @@ def synthetic_dataset(
     )
     labels = np.arange(size, dtype=np.int32) % num_classes
     sigma = DEFAULT_SYNTHETIC_NOISE if noise is None else float(noise)
-    # per-instance low-frequency field: 8x8 -> 4x nearest upsample (kron);
-    # scale BEFORE upsampling (16x less work on the 50k default split)
+    # per-instance low-frequency field (8x8, scaled small) + iid texture,
+    # combined IN PLACE in one full-size buffer: a second (size,32,32,3)
+    # f32 array or a kron temp would double peak memory at the 50k default
+    # split (the hazard the dtype comment above exists for). The broadcast
+    # view add is the 4x nearest-upsample.
     field = noise_rng.standard_normal(size=(size, 8, 8, 3), dtype=np.float32)
     field *= sigma
-    pixels = np.kron(field, np.ones((1, 4, 4, 1), np.float32))
-    texture = noise_rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
-    texture *= sigma / 4.0
-    pixels += texture
+    pixels = noise_rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
+    pixels *= sigma / 4.0  # iid texture
+    pixels.reshape(size, 8, 4, 8, 4, 3)[...] += field[:, :, None, :, None, :]
     pixels += prototypes[labels]
     images = np.clip(pixels, 0, 255, out=pixels).astype(np.uint8)
     return Dataset(images=images, labels=labels, name=name, split=split, synthetic=True)

@@ -11,6 +11,7 @@ import os
 import numpy as np
 import pytest
 
+from simclr_tpu.eval import SWEEP_CONFIG_KEY
 from simclr_tpu.eval import main as eval_main
 from simclr_tpu.main import main as pretrain_main
 from simclr_tpu.save_features import main as save_features_main
@@ -141,11 +142,11 @@ class TestEval:
             ]
         )
         assert set(results.keys()) == {
-            "__config__", "epoch=1-cifar10", "epoch=2-cifar10"
+            SWEEP_CONFIG_KEY, "epoch=1-cifar10", "epoch=2-cifar10"
         }
-        assert results["__config__"]["classifier"] == "centroid"
+        assert results[SWEEP_CONFIG_KEY]["classifier"] == "centroid"
         for key, metrics in results.items():
-            if key == "__config__":
+            if key == SWEEP_CONFIG_KEY:
                 continue
             assert 0.0 <= metrics["val_acc"] <= 1.0
             assert metrics["val_acc"] <= metrics["val_top_5_acc"] <= 1.0
@@ -176,7 +177,7 @@ class TestEval:
 
         resumed = eval_main(args + ["experiment.resume=true"])
         assert set(resumed.keys()) == {
-            "__config__", "epoch=1-cifar10", "epoch=2-cifar10"
+            SWEEP_CONFIG_KEY, "epoch=1-cifar10", "epoch=2-cifar10"
         }
         assert resumed["epoch=1-cifar10"] == {"sentinel": 123}  # skipped
         assert 0.0 <= resumed["epoch=2-cifar10"]["val_acc"] <= 1.0  # recomputed
@@ -207,9 +208,9 @@ class TestEval:
         # the stored blob is untouched by the refused resume
         with open(os.path.join(out, "results.json")) as f:
             blob = json.load(f)
-        assert blob["__config__"]["classifier"] == "centroid"
+        assert blob[SWEEP_CONFIG_KEY]["classifier"] == "centroid"
         assert set(blob.keys()) == {
-            "__config__", "epoch=1-cifar10", "epoch=2-cifar10"
+            SWEEP_CONFIG_KEY, "epoch=1-cifar10", "epoch=2-cifar10"
         }
 
     def test_multirun_sweeps_three_probes(self, pretrain_run, tmp_path):
@@ -229,15 +230,15 @@ class TestEval:
                 f"experiment.save_dir={out}",
             ]
         )
-        assert [r["__config__"]["classifier"] for r in results] == [
+        assert [r[SWEEP_CONFIG_KEY]["classifier"] for r in results] == [
             "centroid", "linear", "nonlinear"
         ]
         for i, kind in enumerate(("centroid", "linear", "nonlinear")):
             with open(os.path.join(out, str(i), "results.json")) as f:
                 blob = json.load(f)
-            assert blob["__config__"]["classifier"] == kind
+            assert blob[SWEEP_CONFIG_KEY]["classifier"] == kind
             assert set(blob.keys()) == {
-                "__config__", "epoch=1-cifar10", "epoch=2-cifar10"
+                SWEEP_CONFIG_KEY, "epoch=1-cifar10", "epoch=2-cifar10"
             }
 
     @pytest.mark.parametrize("content", ["null", '{"trunca'])
@@ -259,7 +260,7 @@ class TestEval:
 
         resumed = eval_main(args + ["experiment.resume=true"])
         assert set(resumed.keys()) == {
-            "__config__", "epoch=1-cifar10", "epoch=2-cifar10"
+            SWEEP_CONFIG_KEY, "epoch=1-cifar10", "epoch=2-cifar10"
         }
         with open(path + ".corrupt") as f:
             assert f.read() == content  # evidence preserved
@@ -276,7 +277,9 @@ class TestEval:
                 f"experiment.save_dir={out}",
             ]
         )
-        for metrics in results.values():
+        for key, metrics in results.items():
+            if key == SWEEP_CONFIG_KEY:
+                continue
             assert len(metrics["val_accuracies"]) == 2
             assert metrics["highest_val_acc"] == max(metrics["val_accuracies"])
             assert all(np.isfinite(v) for v in metrics["val_losses"])
@@ -491,6 +494,6 @@ class TestCifar100:
                 f"experiment.save_dir={tmp_path / 'c100-eval'}",
             ]
         )
-        (metrics,) = results.values()
+        (metrics,) = (v for k, v in results.items() if k != SWEEP_CONFIG_KEY)
         # 100-class synthetic: top-5 >= top-1, both valid probabilities
         assert 0.0 <= metrics["val_acc"] <= metrics["val_top_5_acc"] <= 1.0

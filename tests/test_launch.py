@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+from simclr_tpu.eval import SWEEP_CONFIG_KEY
+
 pytestmark = pytest.mark.slow  # multi-minute on a 1-core host
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -117,7 +119,7 @@ def test_two_process_eval_end_to_end(tmp_path):
 
     results = json.load(open(results_files[0]))
     (ckpt_results,) = (
-        v for k, v in results.items() if k != "__config__"
+        v for k, v in results.items() if k != SWEEP_CONFIG_KEY
     )
     assert 0.0 <= ckpt_results["val_acc"] <= 1.0
 
@@ -168,7 +170,7 @@ def test_two_process_linear_probe_and_save_features(tmp_path):
 
     (results_file,) = list(eval_dir.rglob("results.json"))
     (ckpt_results,) = (
-        v for k, v in json.load(open(results_file)).items() if k != "__config__"
+        v for k, v in json.load(open(results_file)).items() if k != SWEEP_CONFIG_KEY
     )
     assert len(ckpt_results["val_accuracies"]) == 2
     assert all(0.0 <= a <= 1.0 for a in ckpt_results["val_accuracies"])
@@ -546,7 +548,7 @@ def test_four_process_epoch_compile_and_resumed_eval(tmp_path):
     assert result.returncode == 0, result.stderr[-2000:]
     results_path = eval_dir / "results.json"
     blob = json.loads(results_path.read_text())
-    assert set(blob) == {"__config__", "epoch=1-cifar10", "epoch=2-cifar10"}
+    assert set(blob) == {SWEEP_CONFIG_KEY, "epoch=1-cifar10", "epoch=2-cifar10"}
 
     # simulate a crash after checkpoint 1 on the shared FS, then resume
     del blob["epoch=2-cifar10"]
@@ -557,4 +559,4 @@ def test_four_process_epoch_compile_and_resumed_eval(tmp_path):
     resumed = json.loads(results_path.read_text())
     assert resumed["epoch=1-cifar10"] == {"sentinel": 4.0}  # carried, not redone
     assert 0.0 <= resumed["epoch=2-cifar10"]["val_acc"] <= 1.0  # recomputed
-    assert resumed["__config__"]["classifier"] == "centroid"
+    assert resumed[SWEEP_CONFIG_KEY]["classifier"] == "centroid"

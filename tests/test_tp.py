@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from simclr_tpu.eval import SWEEP_CONFIG_KEY
 from simclr_tpu.models.contrastive import ContrastiveModel
 from simclr_tpu.models.heads import ProjectionHead
 from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
@@ -164,7 +165,9 @@ def test_tp_entrypoint_and_eval_round_trip(tmp_path):
             f"experiment.save_dir={out}",
         ]
     )
-    for metrics in results.values():
+    for key, metrics in results.items():
+        if key == SWEEP_CONFIG_KEY:
+            continue
         assert 0.0 <= metrics["val_acc"] <= 1.0
 
 

@@ -45,7 +45,18 @@ block forever, and killing the hung client does not free the device), so:
     ``"captured": "in_round"``) → CPU measurement → a JSON line with
     ``"backend": "none"`` and the error — ``parsed`` is never null.
 
-Env knobs: ``BENCH_PROBE_BUDGET_S`` (total probing wall-clock budget),
+Driver-timeout contract (VERDICT r5 headline: round 5 shipped rc=124 with no
+payload because the 2400 s patient probe budget outlived the driver's
+external ``timeout``): the orchestrator runs under a TOTAL wall-clock budget
+(``BENCH_TOTAL_BUDGET_S``, default 240 s — safely inside a ``timeout 300``)
+and clips every blocking stage — chip-lock wait, probe window, measurement
+subprocesses — against the time remaining, reserving enough tail to walk the
+fallback chain and print. A SIGTERM at any point emits the committed capture
+(or a last-ditch payload) before exiting 0, so even a misjudged budget cannot
+produce a payload-less run.
+
+Env knobs: ``BENCH_TOTAL_BUDGET_S`` (total orchestrator wall clock),
+``BENCH_PROBE_BUDGET_S`` (probing budget, clipped to the total),
 ``BENCH_PROBE_INTERVAL_S`` (sleep between failed probes, default 120 s).
 """
 
@@ -53,6 +64,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -90,8 +102,11 @@ def apply_baseline(payload: dict) -> None:
         "ceiling of its stack (eager fp32 PyTorch DDP, no AMP in "
         "/root/reference): V100 fp32 peak 15.7 TFLOP/s over "
         f"{tflop_per_image * 1000:.2f} GFLOP/image (XLA cost analysis of "
-        "this recipe), so vs_baseline is a LOWER bound on the per-chip "
-        "speedup (BASELINE.md)"
+        "this recipe), so vs_baseline lower-bounds the per-chip speedup "
+        "under direct-convolution FLOP accounting; caveat: cuDNN "
+        "Winograd/FFT algorithms can cut the real 3x3-conv FLOPs ~2.25x, so "
+        "the bound is an estimate with that margin, not strictly provable "
+        "(BASELINE.md)"
     )
 
 def last_ditch_payload(exc: BaseException) -> dict:
@@ -119,6 +134,12 @@ PROBE_BUDGET_NO_CAPTURE_S = 2400  # no fallback number exists: be patient
 PROBE_BUDGET_WITH_CAPTURE_S = 420  # an in-round TPU capture would serve
 TPU_BENCH_TIMEOUT_S = 900
 CPU_BENCH_TIMEOUT_S = 900
+# Total orchestrator wall clock (module docstring, driver-timeout contract).
+# All the budgets above are CLIPPED to what remains of this; the reserves
+# keep enough tail to finish the fallback chain and print the payload.
+TOTAL_BUDGET_S = 240
+EMIT_RESERVE_S = 15           # parse + baseline stamp + print headroom
+CPU_FALLBACK_RESERVE_S = 150  # a cold CPU measurement is compile-dominated
 
 TPU_CAPTURE_PATH = os.environ.get("BENCH_CAPTURE_PATH") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CAPTURE.json"
@@ -155,12 +176,19 @@ def probe_tpu(budget_s: float, interval_s: float = PROBE_INTERVAL_S) -> bool:
     attempt = 0
     while True:
         attempt += 1
+        # a single attempt must not blow past the caller's budget either
+        # (the driver-timeout contract): clip the subprocess timeout to the
+        # time left, with a small floor so the guaranteed first attempt can
+        # still reach a live backend
+        attempt_timeout = min(
+            PROBE_TIMEOUT_S, max(10.0, deadline - time.monotonic())
+        )
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
                 capture_output=True,
                 text=True,
-                timeout=PROBE_TIMEOUT_S,
+                timeout=attempt_timeout,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
         except subprocess.TimeoutExpired:
@@ -527,14 +555,76 @@ def _acquire_chip_lock(wait_s: float):
             time.sleep(min(10.0, max(0.1, deadline - time.monotonic())))
 
 
+_PAYLOAD_EMITTED = False
+
+
+def _emit_payload(result: dict) -> None:
+    """Print the run's single payload line, exactly once.
+
+    (Re-)stamps the baseline fields first: a re-emitted capture or error
+    payload must carry the CURRENT denominator derivation, not the one
+    persisted when the capture was taken. The once-guard lets the SIGTERM
+    backstop fire at any point without ever double-printing.
+    """
+    global _PAYLOAD_EMITTED
+    if _PAYLOAD_EMITTED:
+        return
+    _PAYLOAD_EMITTED = True
+    try:
+        apply_baseline(result)
+    except Exception:  # pragma: no cover — contract keeper
+        pass
+    print(json.dumps(result), flush=True)
+
+
+def _sigterm_backstop(signum, frame) -> None:
+    """Last-resort payload on SIGTERM (e.g. GNU ``timeout`` firing early):
+    emit the committed capture if one exists, else an error payload, then
+    exit 0 immediately — signal-handler context, so no cleanup."""
+    if not _PAYLOAD_EMITTED:
+        capture = load_tpu_capture()
+        _emit_payload(
+            capture
+            if capture is not None
+            else last_ditch_payload(
+                RuntimeError(f"terminated by signal {signum} before finishing")
+            )
+        )
+    os._exit(0)
+
+
 def main() -> None:
-    # default bounded well below any plausible driver timeout: the lock is
-    # only ever held while a watcher stage is actively timing on a LIVE
-    # tunnel, and a 10-min wait covers most of one stage
-    _chip_lock = _acquire_chip_lock(
-        float(os.environ.get("BENCH_LOCK_WAIT_S", 600))
+    global _PAYLOAD_EMITTED
+    _PAYLOAD_EMITTED = False
+    # the driver-timeout contract (module docstring): one absolute deadline,
+    # every blocking stage below clipped to what remains of it
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_TOTAL_BUDGET_S", TOTAL_BUDGET_S)
     )
+
+    def remaining() -> float:
+        return deadline - time.monotonic()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_backstop)
+    except ValueError:  # pragma: no cover — non-main thread (embedded runs)
+        pass
     capture = load_tpu_capture()
+    # with any committed capture the fallback chain needs only the emit
+    # headroom; without one it must fit a cold CPU measurement
+    fallback_reserve = (
+        EMIT_RESERVE_S if capture is not None else CPU_FALLBACK_RESERVE_S
+    )
+    # lock wait default bounded well below any plausible driver timeout: the
+    # lock is only ever held while a watcher stage is actively timing on a
+    # LIVE tunnel, and a 10-min wait covers most of one stage; clipped so at
+    # least one probe attempt plus the fallback chain still fit
+    _chip_lock = _acquire_chip_lock(
+        min(
+            float(os.environ.get("BENCH_LOCK_WAIT_S", 600)),
+            max(0.0, remaining() - fallback_reserve - PROBE_TIMEOUT_S),
+        )
+    )
     # a STALE capture (prior_round) does not shorten the probe budget:
     # prefer spending the patient window re-measuring over re-emitting
     # last round's number (VERDICT r3 item 5)
@@ -546,10 +636,14 @@ def main() -> None:
             else PROBE_BUDGET_NO_CAPTURE_S,
         )
     )
+    budget = min(budget, max(0.0, remaining() - fallback_reserve))
     interval = float(os.environ.get("BENCH_PROBE_INTERVAL_S", PROBE_INTERVAL_S))
     result = None
     if probe_tpu(budget, interval):
-        result = _run_measurement("tpu", TPU_BENCH_TIMEOUT_S)
+        result = _run_measurement(
+            "tpu",
+            int(min(TPU_BENCH_TIMEOUT_S, max(60.0, remaining() - EMIT_RESERVE_S))),
+        )
         if result is not None:
             result.setdefault("captured", "live")
             if _chip_lock is None:
@@ -561,7 +655,7 @@ def main() -> None:
             persist_tpu_capture(result)
     if result is None:
         # re-read: a concurrent tpu_perf_session.sh may have persisted a
-        # capture DURING the (up to 40 min) probe window above
+        # capture DURING the probe window above
         capture = load_tpu_capture() or capture
     if result is None and capture is not None:
         print(
@@ -571,7 +665,10 @@ def main() -> None:
         result = capture
     if result is None:
         print("# falling back to CPU backend", file=sys.stderr)
-        result = _run_measurement("cpu", CPU_BENCH_TIMEOUT_S)
+        result = _run_measurement(
+            "cpu",
+            int(min(CPU_BENCH_TIMEOUT_S, max(30.0, remaining() - EMIT_RESERVE_S))),
+        )
     if result is None:
         result = {
             "metric": "pretrain_imgs_per_sec_per_chip",
@@ -581,11 +678,7 @@ def main() -> None:
             "backend": "none",
             "error": "both TPU and CPU measurements failed; see stderr",
         }
-    # (re-)stamp the baseline fields: a re-emitted capture or error payload
-    # must carry the CURRENT denominator derivation, not the one persisted
-    # when the capture was taken
-    apply_baseline(result)
-    print(json.dumps(result))
+    _emit_payload(result)
 
 
 if __name__ == "__main__":
@@ -600,7 +693,5 @@ if __name__ == "__main__":
         main()
     except Exception as exc:  # pragma: no cover — last-ditch contract keeper
         print(f"# unexpected orchestrator error: {exc!r}", file=sys.stderr)
-        print(
-            json.dumps(last_ditch_payload(exc))
-        )
+        _emit_payload(last_ditch_payload(exc))
     sys.exit(0)

@@ -40,6 +40,7 @@ from simclr_tpu.parallel.mesh import (
     batch_sharding,
     create_mesh,
     replicated_sharding,
+    shard_map,
 )
 from simclr_tpu.parallel.steps import (
     _apply_concat,
@@ -154,7 +155,7 @@ def main() -> None:
     def shmap(f, in_specs, out_specs):
         from jax.sharding import PartitionSpec as P
         spec = {"rep": P(), "batch": P(DATA_AXIS)}
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             f, mesh=mesh,
             in_specs=tuple(spec[s] for s in in_specs),
             out_specs=jax.tree.map(lambda s: spec[s], out_specs),

@@ -30,6 +30,7 @@ from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     create_mesh,
+    put_row_sharded,
     replicated_sharding,
 )
 from simclr_tpu.parallel.steps import make_pretrain_epoch_fn, make_pretrain_step
@@ -45,6 +46,10 @@ VARIANTS = {
     "concat_fused": dict(forward_mode="concat", fused=True),
     "two_pass_remat": dict(forward_mode="two_pass", remat=True),
     "epoch_compile": dict(forward_mode="two_pass"),  # scan path, see below
+    # sharded dataset residency: N/n_data rows per chip + per-step psum
+    # batch assembly — quantifies the collective's cost against the
+    # replicated scan (expected <0.1% of step time, docs/PERF.md)
+    "epoch_compile_sharded": dict(forward_mode="two_pass"),
 }
 
 
@@ -90,12 +95,17 @@ def main() -> None:
     for name in args.variants.split(","):
         kw = VARIANTS[name]
         state = build_state(model, tx, mesh)
-        if name == "epoch_compile":
+        if name.startswith("epoch_compile"):
+            residency = "sharded" if name.endswith("_sharded") else "replicated"
             epoch_fn = make_pretrain_epoch_fn(
                 model, tx, mesh, temperature=0.5, strength=0.5,
-                negatives="global", **kw,
+                negatives="global", residency=residency, **kw,
             )
-            images_all = jax.device_put(ds.images, replicated_sharding(mesh))
+            images_all = (
+                put_row_sharded(ds.images, mesh)
+                if residency == "sharded"
+                else jax.device_put(ds.images, replicated_sharding(mesh))
+            )
             n = ds.images.shape[0]
             steps_per_epoch = args.steps
             idx = np.random.default_rng(0).integers(

@@ -299,7 +299,13 @@ def expand_sweep(argv: list[str]) -> list[list[str]]:
 
     Mirrors Hydra's multirun semantics: every comma-listed override
     contributes one axis, and jobs are the cartesian product in argv order.
-    A bracketed value (``key=[a,b]``) is one YAML list, not a sweep axis.
+    A bracketed value (``key=[a,b]``) is one YAML list, not a sweep axis,
+    and so is a quoted value (``key="a, b"`` — the shell strips nothing
+    inside the quotes, so the comma is literal).
+
+    ``experiment.save_dir`` may not be swept: :func:`run_multirun` overwrites
+    every job's save_dir with ``<sweep_root>/<job_idx>``, so swept values
+    would be silently discarded — rejected here instead.
     """
     import itertools
 
@@ -310,8 +316,21 @@ def expand_sweep(argv: list[str]) -> list[list[str]]:
                 f"override {arg!r} must look like key=value (e.g. parameter.epochs=200)"
             )
         key, raw = arg.split("=", 1)
-        if "," in raw and not raw.strip().startswith("["):
-            values = [v.strip() for v in raw.split(",")]
+        stripped = raw.strip()
+        quoted = (
+            len(stripped) >= 2
+            and stripped[0] in "'\""
+            and stripped[-1] == stripped[0]
+        )
+        if "," in stripped and not quoted and not stripped.startswith("["):
+            if key.strip().lstrip("+") == "experiment.save_dir":
+                raise ConfigError(
+                    f"experiment.save_dir cannot be a sweep axis ({arg!r}): "
+                    "multirun assigns each job <sweep_root>/<job_idx> and "
+                    "would silently ignore the swept values; set a single "
+                    "experiment.save_dir as the sweep root instead"
+                )
+            values = [v.strip() for v in stripped.split(",")]
             if any(not v for v in values):
                 raise ConfigError(f"empty value in sweep override {arg!r}")
             axes.append([f"{key}={v}" for v in values])
@@ -413,6 +432,15 @@ def check_pretrain_conf(cfg: Config) -> None:
         cfg.select("loss.negatives", "global") in ("global", "local", "ring"),
         "loss.negatives must be 'global', 'local', or 'ring'",
     )
+    _check_runtime_conf(cfg)
+
+
+def _check_runtime_conf(cfg: Config) -> None:
+    _require(
+        cfg.select("runtime.dataset_residency", "replicated")
+        in ("replicated", "sharded"),
+        "runtime.dataset_residency must be 'replicated' or 'sharded'",
+    )
 
 
 def check_eval_conf(cfg: Config) -> None:
@@ -432,6 +460,7 @@ def check_supervised_conf(cfg: Config) -> None:
     _require(p.epochs > 0, "parameter.epochs must be positive")
     _require(p.metric in ("loss", "acc"), "parameter.metric must be loss|acc")
     _require(p.warmup_epochs >= 0, "parameter.warmup_epochs must be >= 0")
+    _check_runtime_conf(cfg)
 
 
 def check_save_features_conf(cfg: Config) -> None:

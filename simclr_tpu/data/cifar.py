@@ -157,7 +157,11 @@ def synthetic_dataset(
     pixels = noise_rng.standard_normal(size=(size, 32, 32, 3), dtype=np.float32)
     pixels *= sigma / 4.0  # iid texture
     pixels.reshape(size, 8, 4, 8, 4, 3)[...] += field[:, :, None, :, None, :]
-    pixels += prototypes[labels]
+    # per-class in-place add: prototypes[labels] would materialize a second
+    # full-size (size,32,32,3) f32 temporary, doubling peak memory; per-class
+    # fancy-index adds peak at ~size/num_classes rows instead
+    for c in range(num_classes):
+        pixels[labels == c] += prototypes[c]
     images = np.clip(pixels, 0, 255, out=pixels).astype(np.uint8)
     return Dataset(images=images, labels=labels, name=name, split=split, synthetic=True)
 

@@ -42,6 +42,7 @@ from simclr_tpu.parallel.mesh import (
     batch_sharding,
     mesh_from_config,
     put_replicated,
+    put_row_sharded,
     replicated_sharding,
     validate_per_device_batch,
 )
@@ -168,6 +169,12 @@ def run_pretrain(cfg: Config) -> dict:
         remat=bool(cfg.select("model.remat", False)),
     )
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
+    # runtime.dataset_residency: "replicated" keeps the whole dataset in every
+    # chip's HBM; "sharded" keeps N/n_data rows per data shard and reassembles
+    # each step's batch with one O(global_batch) psum inside the epoch scan
+    # (docs/PERF.md §"Dataset residency")
+    residency = str(cfg.select("runtime.dataset_residency", "replicated"))
+    put_dataset = put_replicated if residency == "replicated" else put_row_sharded
     data_shard = batch_sharding(mesh)
     if n_model > 1:
         # tensor-parallel projection head over the model axis (parallel/tp.py).
@@ -191,15 +198,19 @@ def run_pretrain(cfg: Config) -> dict:
             )
         if epoch_compile:
             check_epoch_compile_preconditions(
-                len(dataset), global_batch, cfg.select("experiment.profile_dir")
+                len(dataset), global_batch, cfg.select("experiment.profile_dir"),
+                dataset_bytes=dataset.images.nbytes,
+                n_data_shards=n_data,
+                residency=residency,
             )
             epoch_fn = make_pretrain_epoch_fn_tp(
                 model, tx, mesh,
                 temperature=step_kwargs["temperature"],
                 strength=step_kwargs["strength"],
                 remat=step_kwargs["remat"],
+                residency=residency,
             )
-            images_all = put_replicated(dataset.images, mesh)
+            images_all = put_dataset(dataset.images, mesh)
             iterator = None
         else:
             step_fn = make_pretrain_step_tp(
@@ -214,13 +225,19 @@ def run_pretrain(cfg: Config) -> dict:
             )
     elif epoch_compile:
         check_epoch_compile_preconditions(
-            len(dataset), global_batch, cfg.select("experiment.profile_dir")
+            len(dataset), global_batch, cfg.select("experiment.profile_dir"),
+            dataset_bytes=dataset.images.nbytes,
+            n_data_shards=n_data,
+            residency=residency,
         )
-        epoch_fn = make_pretrain_epoch_fn(model, tx, mesh, **step_kwargs)
-        # the whole uint8 dataset lives in HBM for the run; batches are
+        epoch_fn = make_pretrain_epoch_fn(
+            model, tx, mesh, residency=residency, **step_kwargs
+        )
+        # the uint8 dataset lives in HBM for the run (full per chip, or
+        # N/n_data rows per shard under sharded residency); batches are
         # gathered on device by shuffled index inside the epoch scan.
-        # put_replicated is the multi-host-safe replicated upload
-        images_all = put_replicated(dataset.images, mesh)
+        # both uploads are multi-host safe
+        images_all = put_dataset(dataset.images, mesh)
         iterator = None
     else:
         step_fn = make_pretrain_step(model, tx, mesh, **step_kwargs)

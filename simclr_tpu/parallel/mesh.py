@@ -29,6 +29,38 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across JAX versions.
+
+    Newer JAX exposes ``jax.shard_map(..., check_vma=)``; older releases only
+    have ``jax.experimental.shard_map.shard_map(..., check_rep=)`` (same
+    semantics, earlier name). Every shard_map in this package goes through
+    this one wrapper so the version split lives in exactly one place.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` across JAX versions.
+
+    Older releases lack it; there a ``psum`` of the literal 1 constant-folds
+    to the same static Python int, so shapes derived from it stay static.
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Logical mesh shape; -1 axes absorb the remaining devices."""
@@ -135,6 +167,34 @@ def put_replicated(array, mesh: Mesh) -> jax.Array:
     collective.
     """
     return jax.device_put(np.asarray(array), replicated_sharding(mesh))
+
+
+def put_row_sharded(array, mesh: Mesh) -> jax.Array:
+    """Row-sharded (over the ``data`` axis) device placement, multi-host safe.
+
+    The sharded-residency counterpart of :func:`put_replicated`
+    (``runtime.dataset_residency=sharded``): data-axis shard ``k`` holds the
+    contiguous row block ``[k*R, (k+1)*R)`` with ``R = ceil(N / n_data)`` —
+    per-chip residency is ~``N/n_data`` rows instead of ``N``. The tail is
+    zero-padded so every shard is equal-sized; padding rows are never
+    touched because epoch index matrices only draw from ``[0, N)``.
+
+    Every process passes the same full host array (the invariant shared
+    with ``put_replicated``); ``make_array_from_callback`` fills only the
+    shards this process addresses, so the upload is O(N / n_processes) per
+    host and — unlike ``put_replicated``'s multi-host equality check — sends
+    no cross-process traffic at all. Divergent per-process data is instead
+    caught downstream by the psum-assembled batches diverging loudly in the
+    loss (the same failure mode as divergent index matrices).
+    """
+    arr = np.asarray(array)
+    n_data = mesh.shape[DATA_AXIS]
+    pad = -len(arr) % n_data
+    if pad:
+        arr = np.concatenate([arr, np.zeros((pad, *arr.shape[1:]), arr.dtype)])
+    return jax.make_array_from_callback(
+        arr.shape, batch_sharding(mesh), lambda idx: arr[idx]
+    )
 
 
 def process_local_rows(n_global_rows: int) -> slice:

@@ -49,7 +49,7 @@ from simclr_tpu.ops.ntxent_pallas import (
     ntxent_loss_fused_sharded,
 )
 from simclr_tpu.ops.ntxent_ring import ntxent_loss_ring
-from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, axis_size, shard_map
 from simclr_tpu.parallel.train_state import TrainState
 
 Metrics = dict[str, jnp.ndarray]
@@ -57,22 +57,65 @@ Metrics = dict[str, jnp.ndarray]
 _REP = P()          # replicated
 _BATCH = P(DATA_AXIS)  # batch dim sharded over the data axis
 
+RESIDENCIES = ("replicated", "sharded")
+
+# fraction of one chip's HBM the resident dataset may claim under
+# epoch_compile — the rest belongs to params/optimizer state/activations
+# (the step's working set; ~8.2 GB of HBM traffic at batch 512, PERF.md)
+DATASET_HBM_FRACTION = 0.5
+
+
+def device_hbm_budget_bytes():
+    """Spare-HBM budget for on-device dataset residency, or None if unknown.
+
+    ``memory_stats`` is backend-dependent: TPU/GPU report ``bytes_limit``;
+    CPU test meshes report nothing, in which case the preflight skips the
+    capacity check rather than guessing.
+    """
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # pragma: no cover — backend-dependent API
+        return None
+    if not stats or not stats.get("bytes_limit"):
+        return None
+    return int(stats["bytes_limit"] * DATASET_HBM_FRACTION)
+
 
 def check_epoch_compile_preconditions(
-    n_samples: int, global_batch: int, profile_dir=None
-) -> None:
+    n_samples: int,
+    global_batch: int,
+    profile_dir=None,
+    *,
+    dataset_bytes: int | None = None,
+    n_data_shards: int = 1,
+    residency: str = "replicated",
+    hbm_budget_bytes: int | None = None,
+):
     """Shared ``runtime.epoch_compile`` preflight for the entry points.
 
-    The epoch-compiled path replicates the whole dataset into HBM and has no
-    per-step host boundary, so it cannot bracket a profiler trace window
+    The epoch-compiled path keeps the whole dataset resident in HBM and has
+    no per-step host boundary, so it cannot bracket a profiler trace window
     around individual steps. Raising here (rather than per entry point)
     keeps ``main.py`` and ``supervised.py`` in lockstep.
 
+    HBM capacity math (``runtime.dataset_residency``): with ``replicated``
+    residency every chip holds all ``dataset_bytes``; with ``sharded``
+    residency each data-axis shard holds only its contiguous
+    ``ceil(n_samples / n_data_shards)`` row block (``mesh.put_row_sharded``),
+    so the per-chip footprint divides by the data-axis size. The check
+    compares that footprint against ``hbm_budget_bytes`` (defaulting to
+    :func:`device_hbm_budget_bytes`; unknown budget — e.g. the CPU test
+    mesh — skips the check). A replicated dataset that would fit sharded
+    fails with the fix spelled out instead of a bare rejection.
+
     Multi-host runs are supported: every process loads the same dataset and
     derives identical index matrices from the shared seed; the dataset
-    upload goes through ``mesh.put_replicated``, whose cross-process
-    equality check turns divergent per-process data into a loud failure.
-    Exercised by real 2-process launches in tests/test_launch.py.
+    upload goes through ``mesh.put_replicated`` (cross-process equality
+    check) or ``mesh.put_row_sharded`` (each process fills only the shards
+    it addresses). Exercised by real 2-process launches in
+    tests/test_launch.py.
+
+    Returns the per-chip resident dataset bytes (None when unknown).
     """
     if n_samples < global_batch:
         # the per-step path raises this inside EpochIterator; here it would
@@ -81,6 +124,38 @@ def check_epoch_compile_preconditions(
             f"dataset of {n_samples} samples smaller than global batch "
             f"{global_batch}"
         )
+    if residency not in RESIDENCIES:
+        raise ValueError(
+            f"dataset_residency must be one of {RESIDENCIES}, got {residency!r}"
+        )
+    resident_bytes = None
+    if dataset_bytes is not None and n_samples > 0:
+        bytes_per_row = dataset_bytes / n_samples
+        rows_resident = (
+            n_samples
+            if residency == "replicated"
+            else -(-n_samples // max(n_data_shards, 1))
+        )
+        resident_bytes = int(rows_resident * bytes_per_row)
+        budget = (
+            device_hbm_budget_bytes()
+            if hbm_budget_bytes is None
+            else hbm_budget_bytes
+        )
+        if budget is not None and resident_bytes > budget:
+            sharded_bytes = int(-(-n_samples // max(n_data_shards, 1)) * bytes_per_row)
+            hint = (
+                f"; runtime.dataset_residency=sharded would hold only "
+                f"{sharded_bytes / 2**20:.0f} MiB per chip "
+                f"({n_data_shards} data shards) and fits this budget"
+                if residency == "replicated" and sharded_bytes <= budget
+                else ""
+            )
+            raise ValueError(
+                f"epoch_compile dataset residency of "
+                f"{resident_bytes / 2**20:.0f} MiB per chip ({residency}) "
+                f"exceeds the {budget / 2**20:.0f} MiB HBM budget{hint}"
+            )
     if profile_dir:
         from simclr_tpu.utils.logging import get_logger
 
@@ -88,6 +163,7 @@ def check_epoch_compile_preconditions(
             "experiment.profile_dir is ignored with runtime.epoch_compile "
             "(no per-step host boundary to bracket a trace window)"
         )
+    return resident_bytes
 
 
 def _augment_two_views(rng, images, strength, out_size):
@@ -235,7 +311,7 @@ def make_pretrain_step(
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
     )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(_REP, _BATCH, _REP),
@@ -257,60 +333,115 @@ def make_pretrain_epoch_fn(
     forward_mode: str = "two_pass",
     remat: bool = False,
     out_size: int = 32,
+    residency: str = "replicated",
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled training: one XLA program per EPOCH, zero host work
     per step.
 
     TPU-first design the reference cannot express: CIFAR fits in HBM (~150 MB
-    uint8), so the whole dataset lives ON DEVICE (replicated over the mesh)
-    and each step's shuffled global batch is gathered by index inside a
-    ``lax.scan`` over the epoch — no per-step ``device_put``, no dispatch
-    latency, no host jitter. The host's only per-epoch work is drawing the
-    shuffle permutation (a (steps, global_batch) int32 array) and reading the
-    loss history back.
+    uint8), so the whole dataset lives ON DEVICE and each step's shuffled
+    global batch is gathered by index inside a ``lax.scan`` over the epoch —
+    no per-step ``device_put``, no dispatch latency, no host jitter. The
+    host's only per-epoch work is drawing the shuffle permutation (a
+    (steps, global_batch) int32 array) and reading the loss history back.
+
+    ``residency`` picks the on-device storage layout: ``"replicated"`` keeps
+    the full dataset in every chip's HBM (upload via ``mesh.put_replicated``);
+    ``"sharded"`` keeps only ``N/n_data`` contiguous rows per data-axis shard
+    (upload via ``mesh.put_row_sharded``) and reassembles each step's batch
+    with one O(global_batch)-byte ``psum`` inside the scan — see
+    :func:`_sharded_rows_global_batch` and docs/PERF.md "Dataset residency".
+    Both layouts index the same rows in the same order, so their loss
+    histories agree to the usual cross-program tolerances (test-asserted).
 
     Returned callable: ``(state, images_all, idx_epoch, base_key, step0) ->
     (state, {"loss": (steps,)})`` where ``images_all`` is the full uint8
-    dataset (replicated), ``idx_epoch`` is ``(steps, global_batch)`` int32
-    row indices, ``base_key`` the run's PRNG key, and ``step0`` the global
-    step index of the epoch's first step. Per-step keys are derived as
-    ``fold_in(base_key, step0 + i)`` — identical to the per-step loop in
-    ``main.py``, so an epoch-compiled run consumes the same data order and
-    RNG streams and is numerically equivalent to the dispatch-per-step run
-    (test-asserted; exact bitwise equality is NOT guaranteed because XLA
-    fuses the scan body differently from the standalone step, reordering
-    bfloat16 roundings).
+    dataset (placed per ``residency``), ``idx_epoch`` is ``(steps,
+    global_batch)`` int32 row indices, ``base_key`` the run's PRNG key, and
+    ``step0`` the global step index of the epoch's first step. Per-step keys
+    are derived as ``fold_in(base_key, step0 + i)`` — identical to the
+    per-step loop in ``main.py``, so an epoch-compiled run consumes the same
+    data order and RNG streams and is numerically equivalent to the
+    dispatch-per-step run (test-asserted; exact bitwise equality is NOT
+    guaranteed because XLA fuses the scan body differently from the
+    standalone step, reordering bfloat16 roundings).
     """
     per_step = _make_local_pretrain_step(
         model, tx,
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
     )
-    return _make_epoch_fn(per_step, mesh, n_arrays=1)
+    return _make_epoch_fn(per_step, mesh, n_arrays=1, residency=residency)
 
 
-def _make_epoch_fn(per_step, mesh, *, n_arrays: int):
+def _sharded_rows_global_batch(local_rows, idx_step):
+    """Reassemble a step's full global batch from row-sharded residency.
+
+    Inside ``shard_map``, ``local_rows`` is this shard's contiguous block of
+    ``rows_per_shard = ceil(N / n_data)`` dataset rows (shard ``k`` owns
+    global rows ``[k*rows_per_shard, (k+1)*rows_per_shard)`` — the
+    ``mesh.put_row_sharded`` layout) and ``idx_step`` is the replicated
+    (global_batch,) index vector. Each shard takes the rows it owns, masked
+    to zero elsewhere, and one ``psum`` over the data axis sums the
+    contributions into the exact full batch: every global index has exactly
+    one owner, so the sum is a disjoint union — exact in any dtype, no uint8
+    overflow. Comm volume is O(global_batch * row_bytes) per step (~1.5 MiB
+    at batch 512 on CIFAR uint8), <0.1% of the step's HBM traffic.
+    """
+    shard = jax.lax.axis_index(DATA_AXIS)
+    rows_per_shard = local_rows.shape[0]
+    rel = idx_step - shard * rows_per_shard
+    owned = (rel >= 0) & (rel < rows_per_shard)
+    picked = jnp.take(local_rows, jnp.where(owned, rel, 0), axis=0)
+    mask = owned.reshape(owned.shape + (1,) * (local_rows.ndim - 1))
+    contrib = jnp.where(mask, picked, jnp.zeros((), local_rows.dtype))
+    return jax.lax.psum(contrib, DATA_AXIS)
+
+
+def _make_epoch_fn(per_step, mesh, *, n_arrays: int, residency: str = "replicated"):
     """Wrap a per-replica step into the epoch ``lax.scan`` scaffolding.
 
     Shared by the pretrain (images) and supervised (images, labels) epoch
     paths so the SPMD mechanics — per-shard index slicing, on-device gather
-    of each replicated per-sample array, per-step key folding — exist once.
+    of each per-sample array, per-step key folding — exist once.
+
+    ``residency="replicated"``: each per-sample array enters replicated and
+    every shard gathers its local batch rows directly. ``"sharded"``: each
+    array enters row-sharded over the data axis (``in_specs=P(DATA_AXIS)``)
+    and the step batch is first reassembled by
+    :func:`_sharded_rows_global_batch` before the local slice is taken —
+    same rows, same order, ``n_data``× less HBM per chip.
+
     Returned callable: ``(state, *arrays, idx_epoch, base_key, step0) ->
     (state, metrics_history)`` with each metrics leaf stacked to (steps,).
     """
+    if residency not in RESIDENCIES:
+        raise ValueError(
+            f"residency must be one of {RESIDENCIES}, got {residency!r}"
+        )
 
     def local_epoch(state: TrainState, *rest):
         arrays = rest[:n_arrays]
         idx_epoch, base_key, step0 = rest[n_arrays:]
         shard = jax.lax.axis_index(DATA_AXIS)
-        n_local = idx_epoch.shape[1] // jax.lax.axis_size(DATA_AXIS)
+        n_local = idx_epoch.shape[1] // axis_size(DATA_AXIS)
 
         def body(state, xs):
             idx_step, i = xs
             local_idx = jax.lax.dynamic_slice_in_dim(
                 idx_step, shard * n_local, n_local
             )
-            gathered = [jnp.take(a, local_idx, axis=0) for a in arrays]
+            if residency == "replicated":
+                gathered = [jnp.take(a, local_idx, axis=0) for a in arrays]
+            else:
+                gathered = [
+                    jax.lax.dynamic_slice_in_dim(
+                        _sharded_rows_global_batch(a, idx_step),
+                        shard * n_local,
+                        n_local,
+                    )
+                    for a in arrays
+                ]
             return per_step(
                 state, *gathered, jax.random.fold_in(base_key, step0 + i)
             )
@@ -320,10 +451,11 @@ def _make_epoch_fn(per_step, mesh, *, n_arrays: int):
             body, state, (idx_epoch, jnp.arange(steps, dtype=jnp.int32))
         )
 
-    sharded = jax.shard_map(
+    array_spec = _REP if residency == "replicated" else _BATCH
+    sharded = shard_map(
         local_epoch,
         mesh=mesh,
-        in_specs=(_REP,) * (n_arrays + 4),
+        in_specs=(_REP,) + (array_spec,) * n_arrays + (_REP,) * 3,
         out_specs=_REP,
         check_vma=False,
     )
@@ -386,7 +518,7 @@ def make_supervised_step(
     local_step = _make_local_supervised_step(
         model, tx, strength=strength, out_size=out_size
     )
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(_REP, _BATCH, _BATCH, _REP),
@@ -403,11 +535,12 @@ def make_supervised_epoch_fn(
     *,
     strength: float = 0.5,
     out_size: int = 32,
+    residency: str = "replicated",
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled supervised training (see
     :func:`make_pretrain_epoch_fn` — same design: dataset resident on
     device, per-epoch ``lax.scan``, identical RNG streams to the per-step
-    loop).
+    loop; ``residency`` shards both images and labels over the data axis).
 
     Returned callable: ``(state, images_all, labels_all, idx_epoch,
     base_key, step0) -> (state, {"loss": (steps,), "accuracy": (steps,)})``.
@@ -415,7 +548,7 @@ def make_supervised_epoch_fn(
     per_step = _make_local_supervised_step(
         model, tx, strength=strength, out_size=out_size
     )
-    return _make_epoch_fn(per_step, mesh, n_arrays=2)
+    return _make_epoch_fn(per_step, mesh, n_arrays=2, residency=residency)
 
 
 def make_supervised_eval_step(model, mesh) -> Callable[..., Metrics]:
@@ -445,7 +578,7 @@ def make_supervised_eval_step(model, mesh) -> Callable[..., Metrics]:
         count = jax.lax.psum(valid.sum(), DATA_AXIS)
         return {"sum_loss": sum_loss, "correct": correct, "count": count}
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(_REP, _REP, _BATCH, _BATCH, _BATCH),

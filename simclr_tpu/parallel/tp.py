@@ -48,8 +48,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from simclr_tpu.models.resnet import feature_dim
 from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
-from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
-from simclr_tpu.parallel.steps import _augment_two_views, _forward_fn
+from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, axis_size, shard_map
+from simclr_tpu.parallel.steps import (
+    RESIDENCIES,
+    _augment_two_views,
+    _forward_fn,
+    _sharded_rows_global_batch,
+)
 from simclr_tpu.parallel.train_state import TrainState
 
 
@@ -157,7 +162,7 @@ def _make_step_body(
     def step(state: TrainState, images: jax.Array, rng: jax.Array):
         p_specs = tree_pspecs(state.params)
         s_specs = tree_pspecs(state.batch_stats)
-        sharded = jax.shard_map(
+        sharded = shard_map(
             local_fwd_bwd,
             mesh=mesh,
             in_specs=(p_specs, s_specs, P(DATA_AXIS), P()),
@@ -214,21 +219,31 @@ def make_pretrain_epoch_fn_tp(
     strength: float = 0.5,
     out_size: int = 32,
     remat: bool = False,
+    residency: str = "replicated",
 ) -> Callable[..., tuple[TrainState, dict]]:
     """Epoch-compiled TP training: ``lax.scan`` over steps at the JIT level.
 
     Same contract as :func:`simclr_tpu.parallel.steps.make_pretrain_epoch_fn`
     — ``(state, images_all, idx_epoch, base_key, step0) -> (state,
-    {"loss": (steps,)})`` with ``images_all`` the full replicated uint8
-    dataset. Structure differs from the dp epoch fn by necessity: the dp
-    path wraps the WHOLE scan in one shard_map, but the TP optimizer update
-    must run at the jit level (LARS trust-ratio norms over the GLOBAL head
-    arrays — see module docstring), so here the scan lives at the jit level
-    and each body iteration re-enters shard_map for the forward/backward
-    only. The per-step batch is gathered by index at the jit level and
-    constrained to the data-axis sharding the step expects; RNG streams
-    (``fold_in(base_key, step0 + i)``) match the per-step loop exactly.
+    {"loss": (steps,)})`` with ``images_all`` the full uint8 dataset, placed
+    per ``residency`` (replicated via ``mesh.put_replicated``, or row-sharded
+    over the data axis via ``mesh.put_row_sharded``). Structure differs from
+    the dp epoch fn by necessity: the dp path wraps the WHOLE scan in one
+    shard_map, but the TP optimizer update must run at the jit level (LARS
+    trust-ratio norms over the GLOBAL head arrays — see module docstring),
+    so here the scan lives at the jit level and each body iteration
+    re-enters shard_map for the forward/backward only. The per-step batch is
+    gathered by index at the jit level — replicated residency takes rows
+    directly and constrains to the data-axis sharding; sharded residency
+    re-enters shard_map to psum-assemble each shard's slice from the row
+    shards (``steps._sharded_rows_global_batch``), emerging already
+    data-sharded. RNG streams (``fold_in(base_key, step0 + i)``) match the
+    per-step loop exactly.
     """
+    if residency not in RESIDENCIES:
+        raise ValueError(
+            f"residency must be one of {RESIDENCIES}, got {residency!r}"
+        )
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
@@ -236,12 +251,29 @@ def make_pretrain_epoch_fn_tp(
     )
     batched = NamedSharding(mesh, P(DATA_AXIS))
 
+    def _local_batch_from_shards(local_rows, idx_step):
+        full = _sharded_rows_global_batch(local_rows, idx_step)
+        shard = jax.lax.axis_index(DATA_AXIS)
+        n_local = idx_step.shape[0] // axis_size(DATA_AXIS)
+        return jax.lax.dynamic_slice_in_dim(full, shard * n_local, n_local)
+
+    gather_sharded = shard_map(
+        _local_batch_from_shards,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P()),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+
     def epoch(state: TrainState, images_all, idx_epoch, base_key, step0):
         def body(state, xs):
             idx_step, i = xs
-            batch = jax.lax.with_sharding_constraint(
-                jnp.take(images_all, idx_step, axis=0), batched
-            )
+            if residency == "replicated":
+                batch = jax.lax.with_sharding_constraint(
+                    jnp.take(images_all, idx_step, axis=0), batched
+                )
+            else:
+                batch = gather_sharded(images_all, idx_step)
             return step(state, batch, jax.random.fold_in(base_key, step0 + i))
 
         steps = idx_epoch.shape[0]

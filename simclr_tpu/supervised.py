@@ -41,6 +41,7 @@ from simclr_tpu.parallel.mesh import (
     process_local_rows,
     put_global_batch,
     put_replicated,
+    put_row_sharded,
     replicated_sharding,
     validate_per_device_batch,
 )
@@ -143,14 +144,21 @@ def run_supervised(cfg: Config) -> dict:
     eval_step = make_supervised_eval_step(model, mesh)
     data_shard = batch_sharding(mesh)
     if epoch_compile:
+        # see main.py: sharded residency keeps N/n_data rows per data shard
+        residency = str(cfg.select("runtime.dataset_residency", "replicated"))
         check_epoch_compile_preconditions(
-            len(train_ds), global_batch, cfg.select("experiment.profile_dir")
+            len(train_ds), global_batch, cfg.select("experiment.profile_dir"),
+            dataset_bytes=train_ds.images.nbytes + train_ds.labels.nbytes,
+            n_data_shards=mesh.shape[DATA_AXIS],
+            residency=residency,
         )
         epoch_fn = make_supervised_epoch_fn(
-            model, tx, mesh, strength=float(cfg.experiment.strength)
+            model, tx, mesh, strength=float(cfg.experiment.strength),
+            residency=residency,
         )
-        images_all = put_replicated(train_ds.images, mesh)
-        labels_all = put_replicated(train_ds.labels, mesh)
+        put_dataset = put_replicated if residency == "replicated" else put_row_sharded
+        images_all = put_dataset(train_ds.images, mesh)
+        labels_all = put_dataset(train_ds.labels, mesh)
         train_iter = None
     else:
         train_step = make_supervised_step(

@@ -17,6 +17,13 @@ import os
 import tempfile
 from typing import Callable, IO
 
+# probed once at import: os.umask(0)+restore is a process-global race — a
+# thread opening files between the two calls would briefly create
+# world-writable artifacts (eval and save_features both write from worker
+# threads)
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
 
 def atomic_write(path: str, write_fn: Callable[[IO], None], mode: str = "w") -> None:
     """Write via ``write_fn(file)`` to a unique temp file, then rename.
@@ -36,9 +43,7 @@ def atomic_write(path: str, write_fn: Callable[[IO], None], mode: str = "w") -> 
     try:
         # mkstemp creates 0600; restore umask-governed permissions so shared
         # artifacts (results JSON, feature exports) stay readable as before
-        umask = os.umask(0)
-        os.umask(umask)
-        os.fchmod(fd, 0o666 & ~umask)
+        os.fchmod(fd, 0o666 & ~_UMASK)
         with os.fdopen(fd, mode) as f:
             write_fn(f)
             f.flush()

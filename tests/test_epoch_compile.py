@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import PartitionSpec as P
 
 from simclr_tpu.data.cifar import synthetic_dataset
 from simclr_tpu.data.pipeline import epoch_index_matrix, epoch_permutation
@@ -21,9 +22,15 @@ from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     create_mesh,
+    put_row_sharded,
     replicated_sharding,
+    shard_map,
 )
-from simclr_tpu.parallel.steps import make_pretrain_epoch_fn, make_pretrain_step
+from simclr_tpu.parallel.steps import (
+    _sharded_rows_global_batch,
+    make_pretrain_epoch_fn,
+    make_pretrain_step,
+)
 from simclr_tpu.parallel.train_state import create_train_state
 from simclr_tpu.utils.schedule import warmup_cosine_schedule
 
@@ -53,7 +60,8 @@ def _init_state(model, tx, mesh):
 
 
 @pytest.mark.slow
-def test_epoch_scan_matches_per_step_loop():
+@pytest.mark.parametrize("residency", ["replicated", "sharded"])
+def test_epoch_scan_matches_per_step_loop(residency):
     mesh, model, tx, ds = _setup()
     base_key = jax.random.key(11)
 
@@ -70,9 +78,19 @@ def test_epoch_scan_matches_per_step_loop():
             losses_a.append(float(m["loss"]))
             cur += 1
 
-    epoch_fn = make_pretrain_epoch_fn(model, tx, mesh, temperature=0.5, strength=0.5)
+    epoch_fn = make_pretrain_epoch_fn(
+        model, tx, mesh, temperature=0.5, strength=0.5, residency=residency
+    )
     state_b = _init_state(model, tx, mesh)
-    images_all = jax.device_put(jnp.asarray(ds.images), replicated_sharding(mesh))
+    if residency == "replicated":
+        images_all = jax.device_put(jnp.asarray(ds.images), replicated_sharding(mesh))
+    else:
+        images_all = put_row_sharded(ds.images, mesh)
+        # the point of sharded residency: each data shard holds only its
+        # N/n_data contiguous row block, not the whole dataset
+        n_data = mesh.shape[DATA_AXIS]
+        assert images_all.sharding.spec == P(DATA_AXIS)
+        assert images_all.addressable_shards[0].data.shape[0] == DATASET // n_data
     losses_b = []
     cur = 0
     for epoch in range(1, EPOCHS + 1):
@@ -93,7 +111,8 @@ def test_epoch_scan_matches_per_step_loop():
 
 
 @pytest.mark.slow
-def test_supervised_epoch_compile_entrypoint(tmp_path):
+@pytest.mark.parametrize("residency", ["replicated", "sharded"])
+def test_supervised_epoch_compile_entrypoint(tmp_path, residency):
     from simclr_tpu.supervised import run_supervised
     from simclr_tpu.config import load_config
 
@@ -106,6 +125,7 @@ def test_supervised_epoch_compile_entrypoint(tmp_path):
             "experiment.synthetic_data=true",
             "experiment.synthetic_size=64",
             "runtime.epoch_compile=true",
+            f"runtime.dataset_residency={residency}",
             f"experiment.save_dir={tmp_path}",
         ],
     )
@@ -117,7 +137,8 @@ def test_supervised_epoch_compile_entrypoint(tmp_path):
 
 
 @pytest.mark.slow
-def test_epoch_compile_entrypoint(tmp_path):
+@pytest.mark.parametrize("residency", ["replicated", "sharded"])
+def test_epoch_compile_entrypoint(tmp_path, residency):
     from simclr_tpu.main import run_pretrain
     from simclr_tpu.config import load_config
 
@@ -131,6 +152,7 @@ def test_epoch_compile_entrypoint(tmp_path):
             "experiment.synthetic_data=true",
             "experiment.synthetic_size=64",
             "runtime.epoch_compile=true",
+            f"runtime.dataset_residency={residency}",
             f"experiment.save_dir={tmp_path}",
         ],
     )
@@ -166,3 +188,79 @@ def test_epoch_compile_preconditions(monkeypatch, caplog):
     # real 2-process run is tests/test_launch.py::test_two_process_epoch_compile
     monkeypatch.setattr(steps.jax, "process_count", lambda: 2)
     check_epoch_compile_preconditions(64, 32)
+
+
+def test_epoch_compile_hbm_preconditions():
+    """HBM capacity math of the preflight: replicated residency counts the
+    whole dataset per chip; sharded counts only the ceil(N/n_data) row
+    block, so a dataset n_data x over the replicated budget still fits."""
+    from simclr_tpu.parallel.steps import check_epoch_compile_preconditions
+
+    # 64 rows x 100 B = 6400 B replicated per chip; budget 1000 B. Sharded
+    # over 8 would hold 8 rows = 800 B, so the error must say so.
+    with pytest.raises(ValueError, match="dataset_residency=sharded"):
+        check_epoch_compile_preconditions(
+            64, 32, dataset_bytes=6400, n_data_shards=8,
+            residency="replicated", hbm_budget_bytes=1000,
+        )
+    # the same dataset under sharded residency fits that budget
+    got = check_epoch_compile_preconditions(
+        64, 32, dataset_bytes=6400, n_data_shards=8,
+        residency="sharded", hbm_budget_bytes=1000,
+    )
+    assert got == 800
+    # replicated within budget passes and reports the full footprint
+    got = check_epoch_compile_preconditions(
+        64, 32, dataset_bytes=6400, n_data_shards=8,
+        residency="replicated", hbm_budget_bytes=10_000,
+    )
+    assert got == 6400
+    # replicated over budget with no sharded escape hatch: no hint
+    with pytest.raises(ValueError) as exc:
+        check_epoch_compile_preconditions(
+            64, 32, dataset_bytes=6400, n_data_shards=1,
+            residency="replicated", hbm_budget_bytes=1000,
+        )
+    assert "dataset_residency=sharded" not in str(exc.value)
+    # unknown residency is rejected before any capacity math
+    with pytest.raises(ValueError, match="dataset_residency"):
+        check_epoch_compile_preconditions(64, 32, residency="spilled")
+
+
+def _gather_fn(mesh):
+    return jax.jit(
+        shard_map(
+            _sharded_rows_global_batch,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def test_sharded_rows_gather_exact():
+    """The psum-assembled global batch from row shards == a plain take on
+    the host array, for uint8 rows and an arbitrary index set."""
+    mesh = create_mesh()
+    rows = np.random.default_rng(0).integers(
+        0, 256, size=(DATASET, 4, 3), dtype=np.uint8
+    )
+    idx = np.asarray([5, 63, 0, 17, 42, 8, 8, 31], np.int32)
+    sharded = put_row_sharded(rows, mesh)
+    got = _gather_fn(mesh)(sharded, jnp.asarray(idx))
+    np.testing.assert_array_equal(np.asarray(got), rows[idx])
+
+
+def test_sharded_rows_gather_padded_tail():
+    """N not divisible by n_data: put_row_sharded zero-pads the tail, and
+    indices in [0, N) never touch the padding."""
+    mesh = create_mesh()
+    n = 61  # pads to 64 over 8 shards
+    rows = np.random.default_rng(1).integers(0, 256, size=(n, 5), dtype=np.uint8)
+    idx = np.asarray([60, 0, 59, 13, 7, 21, 34, 55], np.int32)
+    sharded = put_row_sharded(rows, mesh)
+    assert sharded.shape[0] == 64
+    assert sharded.addressable_shards[0].data.shape[0] == 8
+    got = _gather_fn(mesh)(sharded, jnp.asarray(idx))
+    np.testing.assert_array_equal(np.asarray(got), rows[idx])

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from simclr_tpu.parallel.mesh import shard_map
 
 from simclr_tpu.ops import (
     ntxent_loss,

@@ -24,6 +24,7 @@ from simclr_tpu.parallel.mesh import (
     MeshSpec,
     batch_sharding,
     create_mesh,
+    shard_map,
 )
 from simclr_tpu.parallel.tp import (
     make_pretrain_epoch_fn_tp,
@@ -69,7 +70,7 @@ def test_sharded_head_forward_matches_unsharded():
     def fwd(p, s, x):
         return local.apply({"params": p, "batch_stats": s}, x, train=False)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         fwd, mesh=mesh, in_specs=(p_specs, s_specs, P()), out_specs=P(),
         check_vma=False,
     )
@@ -324,7 +325,7 @@ def test_tp_output_psum_operand_is_f32():
     def fwd(p, s, x):
         return local.apply({"params": p, "batch_stats": s}, x, train=False)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         fwd, mesh=mesh, in_specs=(p_specs, s_specs, P()), out_specs=P(),
         check_vma=False,
     )
@@ -411,6 +412,63 @@ def test_tp_epoch_compile_entrypoint(tmp_path):
     )
     assert summary["steps"] == 64 // (4 * 4)
     assert np.isfinite(summary["final_loss"])
+
+
+@pytest.mark.slow
+def test_tp_epoch_compile_sharded_residency_matches_replicated():
+    """dataset_residency=sharded on a (data=4, model=2) mesh reproduces the
+    replicated epoch fn's loss history and params while each data shard
+    holds only N/4 dataset rows (pinned on the uploaded array's sharding).
+    Exercises the shard_map psum-gather path under tensor parallelism."""
+    from simclr_tpu.parallel.mesh import put_row_sharded
+
+    mesh = create_mesh(MeshSpec(data=4, model=2))
+    model = ContrastiveModel(
+        base_cnn="resnet18", d=128, dtype=jnp.float32,
+        bn_cross_replica_axis=DATA_AXIS,
+    )
+    tx = lars(
+        warmup_cosine_schedule(0.1, 20, 2),
+        weight_decay=1e-4,
+        weight_decay_mask=simclr_weight_decay_mask,
+    )
+
+    def fresh_state():
+        s = create_train_state(
+            model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
+        )
+        return jax.device_put(s, tp_state_shardings(mesh, s))
+
+    n = 16
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(n, 32, 32, 3), dtype=np.uint8
+    )
+    idx = np.asarray(
+        [[3, 1, 8, 9, 12, 0, 5, 7], [2, 4, 6, 10, 11, 13, 14, 15]], np.int32
+    )
+    base = jax.random.key(42)
+
+    runs = {}
+    for residency in ("replicated", "sharded"):
+        epoch_fn = make_pretrain_epoch_fn_tp(model, tx, mesh, residency=residency)
+        if residency == "replicated":
+            images_dev = jnp.asarray(images)
+        else:
+            images_dev = put_row_sharded(images, mesh)
+            assert images_dev.sharding.spec == P(DATA_AXIS)
+            assert images_dev.addressable_shards[0].data.shape[0] == n // 4
+        state, hist = epoch_fn(fresh_state(), images_dev, jnp.asarray(idx), base, 0)
+        runs[residency] = (np.asarray(hist["loss"]), jax.device_get(state.params))
+
+    np.testing.assert_allclose(
+        runs["sharded"][0], runs["replicated"][0], rtol=1e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        runs["sharded"][1], runs["replicated"][1],
+    )
 
 
 def test_tp_rejects_unsupported_combinations():

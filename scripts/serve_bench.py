@@ -1,0 +1,320 @@
+"""Load generator for the embedding server: concurrency sweep -> ONE JSON line.
+
+Drives ``POST /v1/embed`` at increasing client concurrency and reports the
+best sustained throughput plus latency quantiles:
+
+    {"metric": "serve_requests_per_sec", "value": ..., "unit": "req/s",
+     "best_concurrency": ..., "p50_ms": ..., "p95_ms": ..., "p99_ms": ...,
+     "levels": {...}, ...}
+
+Two modes:
+
+  * ``SERVE_BENCH_URL=http://host:port`` — benchmark a server you already
+    started (``python -m simclr_tpu.serve ...``); the generator is pure
+    stdlib and imports no jax.
+  * no URL — self-host: build an in-process server around a RANDOM-INIT
+    eval model (resnet18 by default; weights don't matter for throughput)
+    on whatever backend JAX_PLATFORMS selects, sweep against it, tear it
+    down. No checkpoint required, so the script runs anywhere the test
+    suite runs.
+
+Robustness contract (same as bench.py): this script NEVER exits nonzero and
+NEVER prints a traceback as its last line; it emits EXACTLY ONE payload
+line. A total wall-clock budget (``SERVE_BENCH_BUDGET_S``, default 180 s)
+clips the sweep — levels that don't fit are dropped and recorded under
+``"skipped_levels"`` rather than silently missing — and a SIGTERM at any
+point emits the best-so-far payload before exiting 0.
+
+Env knobs: ``SERVE_BENCH_URL``, ``SERVE_BENCH_CONCURRENCY`` (default
+``1,2,4,8``), ``SERVE_BENCH_ROWS`` (rows per request, default 1),
+``SERVE_BENCH_DURATION_S`` (seconds per level, default 5),
+``SERVE_BENCH_BUDGET_S``, ``SERVE_BENCH_MAX_BATCH`` (self-host, default 32),
+``SERVE_BENCH_TINY`` (self-host with the test suite's tiny model instead of
+resnet18).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from urllib.parse import urlparse
+
+# repo-root import shim, as in the sibling perf scripts (only the self-host
+# mode imports simclr_tpu; the URL mode stays pure stdlib)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_CONCURRENCY = "1,2,4,8"
+DEFAULT_ROWS = 1
+DEFAULT_DURATION_S = 5.0
+DEFAULT_BUDGET_S = 180.0
+EMIT_RESERVE_S = 5.0  # headroom to assemble and print the payload
+
+_PAYLOAD_EMITTED = False
+_BEST_SO_FAR: dict | None = None
+
+
+def _emit_payload(payload: dict) -> None:
+    """Print the run's single payload line, exactly once (bench.py contract)."""
+    global _PAYLOAD_EMITTED
+    if _PAYLOAD_EMITTED:
+        return
+    _PAYLOAD_EMITTED = True
+    print(json.dumps(payload), flush=True)
+
+
+def last_ditch_payload(exc: BaseException) -> dict:
+    return {
+        "metric": "serve_requests_per_sec",
+        "value": 0.0,
+        "unit": "req/s",
+        "error": repr(exc),
+    }
+
+
+def _sigterm_backstop(signum, frame) -> None:
+    """Emit best-so-far (or an error payload) and exit 0 immediately."""
+    if not _PAYLOAD_EMITTED:
+        _emit_payload(
+            _BEST_SO_FAR
+            if _BEST_SO_FAR is not None
+            else last_ditch_payload(
+                RuntimeError(f"terminated by signal {signum} before finishing")
+            )
+        )
+    os._exit(0)
+
+
+def quantile(sorted_data: list[float], q: float) -> float:
+    """Linear-interpolated quantile of pre-sorted data (NaN when empty)."""
+    if not sorted_data:
+        return float("nan")
+    pos = q * (len(sorted_data) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_data) - 1)
+    return sorted_data[lo] + (sorted_data[hi] - sorted_data[lo]) * (pos - lo)
+
+
+def make_body(rows: int) -> bytes:
+    """One request body: ``rows`` deterministic pseudo-images (no numpy)."""
+    img = [[[(x * 7 + y * 13 + c * 29) % 256 for c in range(3)] for y in range(32)]
+           for x in range(32)]
+    return json.dumps({"instances": [img] * rows}).encode()
+
+
+def run_level(
+    host: str, port: int, concurrency: int, rows: int, duration_s: float
+) -> dict:
+    """One sweep level: ``concurrency`` closed-loop clients for ``duration_s``.
+
+    Each client reuses one keep-alive connection and fires requests
+    back-to-back; 429s are counted and retried after a short backoff (they
+    are the server doing its job, not a failure)."""
+    body = make_body(rows)
+    latencies: list[float] = []
+    counters = {"ok": 0, "rejected": 0, "errors": 0}
+    lock = threading.Lock()
+    start_barrier = threading.Barrier(concurrency + 1)
+    stop = threading.Event()
+
+    def client():
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        start_barrier.wait()
+        try:
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    conn.request(
+                        "POST", "/v1/embed", body,
+                        {"Content-Type": "application/json"},
+                    )
+                    r = conn.getresponse()
+                    r.read()
+                    status = r.status
+                except (http.client.HTTPException, OSError):
+                    conn.close()
+                    conn = http.client.HTTPConnection(host, port, timeout=30)
+                    with lock:
+                        counters["errors"] += 1
+                    continue
+                dt_ms = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    if status == 200:
+                        counters["ok"] += 1
+                        latencies.append(dt_ms)
+                    elif status == 429:
+                        counters["rejected"] += 1
+                    else:
+                        counters["errors"] += 1
+                if status == 429:
+                    time.sleep(0.01)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, daemon=True) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    start_barrier.wait()
+    t_start = time.perf_counter()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    elapsed = time.perf_counter() - t_start
+    latencies.sort()
+    ok = counters["ok"]
+    return {
+        "concurrency": concurrency,
+        "requests_per_sec": round(ok / elapsed, 2),
+        "rows_per_sec": round(ok * rows / elapsed, 2),
+        "p50_ms": round(quantile(latencies, 0.50), 2),
+        "p95_ms": round(quantile(latencies, 0.95), 2),
+        "p99_ms": round(quantile(latencies, 0.99), 2),
+        "completed": ok,
+        "rejected": counters["rejected"],
+        "errors": counters["errors"],
+        "duration_s": round(elapsed, 2),
+    }
+
+
+def assemble_payload(levels: list[dict], rows: int, extra: dict) -> dict:
+    """Best-throughput headline over the levels measured so far."""
+    best = max(levels, key=lambda r: r["requests_per_sec"], default=None)
+    payload = {
+        "metric": "serve_requests_per_sec",
+        "value": best["requests_per_sec"] if best else 0.0,
+        "unit": "req/s",
+        "rows_per_request": rows,
+        "best_concurrency": best["concurrency"] if best else 0,
+        "p50_ms": best["p50_ms"] if best else float("nan"),
+        "p95_ms": best["p95_ms"] if best else float("nan"),
+        "p99_ms": best["p99_ms"] if best else float("nan"),
+        "levels": {str(r["concurrency"]): r for r in levels},
+    }
+    payload.update(extra)
+    return payload
+
+
+def self_hosted_server(max_batch: int):
+    """(server, batcher, serve_forever-thread, extra-provenance) around a
+    random-init model — throughput needs a real forward, not real weights."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from simclr_tpu.config import load_config
+    from simclr_tpu.serve.engine import EmbedEngine
+    from simclr_tpu.serve.metrics import ServeMetrics
+    from simclr_tpu.serve.server import start_server
+
+    cfg = load_config(
+        "serve",
+        overrides=[
+            "serve.port=0",
+            f"serve.max_batch={max_batch}",
+            "experiment.target_dir=unused-self-hosted",
+        ],
+    )
+    if os.environ.get("SERVE_BENCH_TINY"):
+        from tests.helpers import TinyContrastive
+
+        model = TinyContrastive(bn_cross_replica_axis=None)
+        model_name = "tiny-random-init"
+    else:
+        from simclr_tpu.eval import build_eval_model
+
+        model = build_eval_model(cfg)
+        model_name = f"{cfg.experiment.base_cnn}-random-init"
+    variables = jax.tree.map(
+        np.asarray,
+        model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3), jnp.float32)),
+    )
+    metrics = ServeMetrics()
+    print(f"# self-hosting {model_name}, warming {max_batch=} buckets...",
+          file=sys.stderr)
+    engine = EmbedEngine(model, variables, max_batch=max_batch, metrics=metrics)
+    server, batcher = start_server(cfg, engine=engine, metrics=metrics)
+    thread = threading.Thread(
+        target=server.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
+    )
+    thread.start()
+    extra = {
+        "self_hosted": True,
+        "model": model_name,
+        "backend": jax.default_backend(),
+        "max_batch": max_batch,
+    }
+    return server, batcher, thread, extra
+
+
+def main() -> None:
+    global _BEST_SO_FAR
+    deadline = time.monotonic() + float(
+        os.environ.get("SERVE_BENCH_BUDGET_S", DEFAULT_BUDGET_S)
+    )
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_backstop)
+    except ValueError:  # non-main thread (embedded runs)
+        pass
+
+    rows = int(os.environ.get("SERVE_BENCH_ROWS", DEFAULT_ROWS))
+    duration_s = float(os.environ.get("SERVE_BENCH_DURATION_S", DEFAULT_DURATION_S))
+    concurrency_levels = [
+        int(c)
+        for c in os.environ.get("SERVE_BENCH_CONCURRENCY", DEFAULT_CONCURRENCY).split(",")
+        if c.strip()
+    ]
+
+    url = os.environ.get("SERVE_BENCH_URL")
+    server = thread = None
+    if url:
+        parsed = urlparse(url if "//" in url else f"http://{url}")
+        host, port = parsed.hostname, parsed.port or 80
+        extra = {"self_hosted": False, "target": f"{host}:{port}"}
+    else:
+        server, _batcher, thread, extra = self_hosted_server(
+            int(os.environ.get("SERVE_BENCH_MAX_BATCH", 32))
+        )
+        host, port = server.server_address[:2]
+
+    try:
+        levels: list[dict] = []
+        skipped: list[int] = []
+        for c in concurrency_levels:
+            # deadline discipline: a level that cannot finish inside the
+            # budget is dropped LOUDLY, not silently
+            budget_left = deadline - time.monotonic() - EMIT_RESERVE_S
+            if budget_left < 1.0:
+                skipped.append(c)
+                continue
+            level = run_level(host, port, c, rows, min(duration_s, budget_left))
+            levels.append(level)
+            print(f"# level {level}", file=sys.stderr)
+            _BEST_SO_FAR = assemble_payload(levels, rows, extra)
+        payload = assemble_payload(levels, rows, extra)
+        if skipped:
+            payload["skipped_levels"] = skipped
+            print(f"# budget exhausted; skipped concurrency levels {skipped}",
+                  file=sys.stderr)
+        _emit_payload(payload)
+    finally:
+        if server is not None:
+            from simclr_tpu.serve.server import shutdown_gracefully
+
+            shutdown_gracefully(server, drain_timeout_s=10)
+            if thread is not None:
+                thread.join(timeout=10)
+            server.server_close()
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as exc:  # last-ditch contract keeper: one line, rc 0
+        print(f"# unexpected error: {exc!r}", file=sys.stderr)
+        _emit_payload(last_ditch_payload(exc))
+    sys.exit(0)

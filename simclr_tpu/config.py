@@ -466,3 +466,20 @@ def check_supervised_conf(cfg: Config) -> None:
 def check_save_features_conf(cfg: Config) -> None:
     _require(bool(cfg.experiment.target_dir), "experiment.target_dir must be set")
     _require(cfg.experiment.target_dir != "DUMMY-PATH", "experiment.target_dir must be set")
+
+
+def check_serve_conf(cfg: Config) -> None:
+    s = cfg.select("serve")
+    _require(s is not None, "serve config group missing (load_config('serve'))")
+    _require(int(s.max_batch) > 0, "serve.max_batch must be positive")
+    _require(float(s.max_delay_ms) >= 0, "serve.max_delay_ms must be >= 0")
+    _require(int(s.queue_depth) > 0, "serve.queue_depth must be positive")
+    _require(float(s.request_timeout_s) > 0, "serve.request_timeout_s must be positive")
+    _require(0 <= int(s.port) <= 65535, "serve.port must be in [0, 65535]")
+    # one of the checkpoint sources must be real
+    if not s.get("checkpoint"):
+        _require(
+            bool(cfg.experiment.target_dir)
+            and cfg.experiment.target_dir != "DUMMY-PATH",
+            "set experiment.target_dir (checkpoint run dir) or serve.checkpoint",
+        )

@@ -56,6 +56,7 @@ from simclr_tpu.parallel.mesh import (
 )
 from simclr_tpu.parallel.steps import make_encode_step
 from simclr_tpu.utils.checkpoint import list_checkpoints_or_raise, restore_checkpoint
+from simclr_tpu.utils.fetch import fetch
 from simclr_tpu.utils.ioutil import atomic_write
 from simclr_tpu.utils.logging import get_logger, is_logging_host
 from simclr_tpu.utils.schedule import calculate_initial_lr
@@ -97,20 +98,6 @@ def load_model_variables(ckpt_path: str) -> dict:
     )
 
 
-def _fetch(x: jax.Array) -> np.ndarray:
-    """Device array -> host numpy, multi-host safe.
-
-    Under multi-host SPMD the encode output is sharded over chips this
-    process cannot address; ``process_allgather`` assembles the full array on
-    every host (features are small: N x 512 floats).
-    """
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-    return np.asarray(x)
-
-
 def extract_features(
     model, variables, images: np.ndarray, mesh, batch: int, use_full_encoder: bool
 ) -> np.ndarray:
@@ -130,7 +117,7 @@ def extract_features(
         # dispatch only — async dispatch pipelines upload/compute across
         # chunks; the device->host sync happens once below
         outs.append(encode(variables["params"], variables["batch_stats"], chunk))
-    return np.concatenate([_fetch(o) for o in outs])[:n]
+    return np.concatenate([fetch(o) for o in outs])[:n]
 
 
 def _topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, top_k: int):

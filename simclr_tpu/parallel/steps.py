@@ -606,7 +606,7 @@ def make_encode_step(
     Explicit in/out shardings over ``mesh`` make this a true global SPMD
     program: the batch stays sharded over the data axis end to end (the
     multi-host input side is ``mesh.put_global_batch``, the output side
-    ``_fetch``'s process_allgather), variables are replicated.
+    ``utils.fetch.fetch``'s process_allgather), variables are replicated.
     """
     rep = NamedSharding(mesh, _REP)
     batched = NamedSharding(mesh, _BATCH)

@@ -26,7 +26,6 @@ import numpy as np
 from simclr_tpu.config import Config, check_save_features_conf, load_config, resolve_save_dir
 from simclr_tpu.data.cifar import load_dataset
 from simclr_tpu.eval import (
-    _fetch,
     build_eval_model,
     extract_features,
     load_model_variables,
@@ -40,6 +39,7 @@ from simclr_tpu.parallel.mesh import (
 )
 from simclr_tpu.parallel.steps import make_augmented_encode_step
 from simclr_tpu.utils.checkpoint import list_checkpoints_or_raise
+from simclr_tpu.utils.fetch import fetch
 from simclr_tpu.utils.ioutil import atomic_write
 from simclr_tpu.utils.logging import get_logger, is_logging_host
 
@@ -81,7 +81,7 @@ def augmented_features(
             # dispatch only; the device->host sync happens once per pass so
             # upload/compute pipeline across chunks (see eval.extract_features)
             feats.append(encode(variables["params"], variables["batch_stats"], chunk, rng))
-        pass_feats = np.concatenate([_fetch(f) for f in feats])[:n]
+        pass_feats = np.concatenate([fetch(f) for f in feats])[:n]
         mean = pass_feats if mean is None else mean + (pass_feats - mean) / t
         if t in snapshots:
             out[t] = mean.copy()

@@ -1,0 +1,48 @@
+"""Dynamic-batching embedding inference server (``python -m simclr_tpu.serve``).
+
+Turns a trained checkpoint into a live HTTP embedding service:
+
+  * :mod:`~simclr_tpu.serve.engine` — checkpoint restore + power-of-two
+    bucketed jitted forward, warmup-compiled at startup;
+  * :mod:`~simclr_tpu.serve.batcher` — bounded queue, dynamic
+    micro-batching, backpressure, graceful drain;
+  * :mod:`~simclr_tpu.serve.server` — stdlib ThreadingHTTPServer JSON API
+    (``POST /v1/embed``, ``GET /healthz``, ``GET /metrics``), SIGTERM →
+    drain → exit 0;
+  * :mod:`~simclr_tpu.serve.metrics` — Prometheus-text counters, gauges,
+    and latency summaries.
+
+Knobs live under the ``serve:`` group of ``conf/serve.yaml``; operational
+docs in ``docs/SERVING.md``. Imports here are lazy so touching the light
+pieces (batcher, metrics) never pays the jax import.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "BackpressureError",
+    "BatcherClosedError",
+    "DynamicBatcher",
+    "EmbedEngine",
+    "ServeMetrics",
+    "run_server",
+    "start_server",
+]
+
+_EXPORTS = {
+    "BackpressureError": "simclr_tpu.serve.batcher",
+    "BatcherClosedError": "simclr_tpu.serve.batcher",
+    "DynamicBatcher": "simclr_tpu.serve.batcher",
+    "EmbedEngine": "simclr_tpu.serve.engine",
+    "ServeMetrics": "simclr_tpu.serve.metrics",
+    "run_server": "simclr_tpu.serve.server",
+    "start_server": "simclr_tpu.serve.server",
+}
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,214 @@
+"""Dynamic micro-batching: coalesce concurrent requests into engine batches.
+
+The serving analogue of ``data/prefetch.py``'s queue-and-drain discipline,
+inverted: many producer threads (HTTP handlers) feed one consumer (the
+engine worker). Requests enter a **bounded** queue — a full queue rejects
+immediately (:class:`BackpressureError`, surfaced as HTTP 429) instead of
+letting latency grow without bound — and the worker coalesces whatever is
+queued into one batch, waiting at most ``max_delay_ms`` after the first
+request before dispatching, never exceeding ``max_batch`` rows.
+
+Why coalesce at all: the engine's cost per forward is dominated by fixed
+dispatch + weight-streaming overhead at small batches, so N concurrent
+1-row requests served as one N-row bucket cost barely more than one of
+them alone (the Podracer batched-inference observation). ``max_delay_ms``
+bounds the latency price the first request pays for that throughput.
+
+Shutdown is a graceful drain: ``close()`` stops intake, the worker answers
+everything already queued, and only then exits — no accepted request is
+ever dropped (the SIGTERM contract in ``server.py``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+_POLL_S = 0.05
+
+
+class BackpressureError(RuntimeError):
+    """The request queue is full — shed load now, retry later (HTTP 429)."""
+
+
+class BatcherClosedError(RuntimeError):
+    """The batcher is shutting down and no longer accepts requests (503)."""
+
+
+@dataclass
+class _Pending:
+    images: np.ndarray
+    future: Future = field(default_factory=Future)
+    submitted_at: float = field(default_factory=time.perf_counter)
+
+    @property
+    def n_rows(self) -> int:
+        return self.images.shape[0]
+
+
+class DynamicBatcher:
+    """Bounded request queue + single dispatch worker over ``embed_fn``.
+
+    ``embed_fn(images) -> embeddings`` is called from exactly one thread
+    (the worker), with at most ``max_batch`` rows per call; per-request row
+    slices of its output resolve the corresponding futures.
+    """
+
+    def __init__(
+        self,
+        embed_fn,
+        *,
+        max_batch: int = 256,
+        max_delay_ms: float = 5.0,
+        queue_depth: int = 64,
+        metrics=None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_delay_ms < 0:
+            raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self._embed_fn = embed_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.metrics = metrics
+        self._q: queue.Queue[_Pending] = queue.Queue(maxsize=queue_depth)
+        self._closed = threading.Event()   # stop intake; worker drains then exits
+        self._abort = threading.Event()    # stop now; queued futures fail
+        self._worker = threading.Thread(
+            target=self._run, name="serve-batcher", daemon=True
+        )
+        self._worker.start()
+        if metrics is not None:
+            metrics.queue_depth.set_fn(self._q.qsize)
+
+    # -- producer side (HTTP handler threads) ------------------------------
+    def submit(self, images: np.ndarray) -> Future:
+        """Enqueue one request; returns a Future of its ``(n, d)`` embeddings.
+
+        Raises :class:`BatcherClosedError` during shutdown and
+        :class:`BackpressureError` when the queue is full — both BEFORE
+        accepting the work, so every accepted future is guaranteed an
+        answer (result or exception).
+        """
+        if self._closed.is_set():
+            raise BatcherClosedError("batcher is draining; not accepting requests")
+        item = _Pending(np.asarray(images))
+        if not 0 < item.n_rows <= self.max_batch:
+            raise ValueError(
+                f"request must carry 1..{self.max_batch} rows, got {item.n_rows}"
+            )
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            if self.metrics is not None:
+                self.metrics.rejected_total.inc()
+            raise BackpressureError(
+                f"request queue full ({self._q.maxsize} pending); retry later"
+            ) from None
+        if self.metrics is not None:
+            self.metrics.requests_total.inc()
+            self.metrics.rows_total.inc(item.n_rows)
+        return item.future
+
+    # -- consumer side (the one worker thread) -----------------------------
+    def _run(self) -> None:
+        carry: _Pending | None = None
+        while not self._abort.is_set():
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = self._q.get(timeout=_POLL_S)
+                except queue.Empty:
+                    if self._closed.is_set():
+                        return  # drained: intake stopped and queue empty
+                    continue
+            batch = [first]
+            rows = first.n_rows
+            deadline = time.perf_counter() + self.max_delay_s
+            while rows < self.max_batch and not self._abort.is_set():
+                try:
+                    nxt = self._q.get(
+                        timeout=max(0.0, deadline - time.perf_counter())
+                    )
+                except queue.Empty:
+                    break
+                if rows + nxt.n_rows > self.max_batch:
+                    carry = nxt  # opens the next batch; never dropped
+                    break
+                batch.append(nxt)
+                rows += nxt.n_rows
+            self._dispatch(batch)
+        # aborted: fail whatever never got dispatched
+        for item in ([carry] if carry is not None else []) + self._drain():
+            item.future.set_exception(BatcherClosedError("batcher aborted"))
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        if self.metrics is not None:
+            self.metrics.batch_requests_total.inc(len(batch))
+        try:
+            images = (
+                batch[0].images
+                if len(batch) == 1
+                else np.concatenate([p.images for p in batch])
+            )
+            out = self._embed_fn(images)
+        except BaseException as e:  # noqa: BLE001 - relayed to every caller
+            if self.metrics is not None:
+                self.metrics.failed_total.inc(len(batch))
+            for p in batch:
+                p.future.set_exception(e)
+            return
+        done = time.perf_counter()
+        offset = 0
+        for p in batch:
+            p.future.set_result(out[offset : offset + p.n_rows])
+            offset += p.n_rows
+            if self.metrics is not None:
+                self.metrics.request_latency_ms.observe(
+                    (done - p.submitted_at) * 1000.0
+                )
+
+    def _drain(self) -> list[_Pending]:
+        items = []
+        try:
+            while True:
+                items.append(self._q.get_nowait())
+        except queue.Empty:
+            return items
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, drain: bool = True, timeout: float = 30.0) -> bool:
+        """Stop intake and shut the worker down.
+
+        ``drain=True`` (the SIGTERM path): every already-queued request is
+        dispatched and answered before the worker exits. ``drain=False``:
+        the worker stops at the next poll and queued futures fail with
+        :class:`BatcherClosedError`. Returns True if the worker exited
+        within ``timeout`` (it is a daemon thread either way, so a wedged
+        engine cannot hang interpreter shutdown).
+        """
+        self._closed.set()
+        if not drain:
+            self._abort.set()
+        self._worker.join(timeout=timeout)
+        alive = self._worker.is_alive()
+        if alive and drain:
+            # drain overran the timeout: abort so stragglers fail fast
+            # rather than dangling unanswered
+            self._abort.set()
+            self._worker.join(timeout=_POLL_S * 4)
+        return not self._worker.is_alive()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,236 @@
+"""Embedding engine: a trained checkpoint behind a bucketed jitted forward.
+
+The serving counterpart of ``eval.extract_features``: restore the encoder
+from an orbax checkpoint (integrity-verified, ``utils/checkpoint.py``),
+build the no-augmentation frozen forward out of
+``models/contrastive.ContrastiveModel`` (float32, ``to_float`` uint8
+normalization — numerically the same forward eval and save_features use),
+and serve arbitrary-size request batches through a small set of static
+shapes:
+
+  * request batches are padded up to the nearest **power-of-two bucket**
+    (1, 2, 4, … ``max_batch``) and sliced back after the forward, so XLA
+    compiles one program per bucket instead of one per request size;
+  * every bucket is **warmup-compiled at startup** (fenced with
+    ``utils.profiling.synchronize`` — a value fetch, the only reliable
+    completion fence on remote-tunneled runtimes), so no live request ever
+    pays a compile;
+  * the engine is deliberately single-device (the jit default device):
+    request batches are latency-bound and small, so data-parallel sharding
+    buys nothing per request — scale-out is one engine process per chip
+    behind a load balancer (capacity math in ``docs/SERVING.md``).
+
+Thread model: ``embed`` is called only from the batcher's single worker
+thread; construction and warmup happen before the worker starts.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simclr_tpu.data.augment import to_float
+from simclr_tpu.utils.fetch import fetch
+from simclr_tpu.utils.profiling import synchronize
+
+
+class RequestTooLargeError(ValueError):
+    """A single request carries more rows than the largest bucket."""
+
+
+def make_buckets(max_batch: int) -> tuple[int, ...]:
+    """Power-of-two batch buckets up to ``max_batch`` (inclusive).
+
+    A non-power-of-two ``max_batch`` contributes itself as the final bucket
+    (``max_batch=24`` -> ``(1, 2, 4, 8, 16, 24)``), so the configured
+    ceiling is always exactly servable.
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+class EmbedEngine:
+    """Checkpoint -> warm compiled forwards -> ``embed(images)``.
+
+    ``model`` is any flax module with the :class:`ContrastiveModel` API
+    (``encode``/``__call__``, params + batch_stats); ``variables`` a host
+    pytree with ``params`` and ``batch_stats``. ``use_full_encoder=False``
+    serves encoder features h (the representation probes consume); True
+    serves projection-head output z.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables: dict,
+        *,
+        max_batch: int = 256,
+        use_full_encoder: bool = False,
+        input_shape: tuple[int, ...] = (32, 32, 3),
+        metrics=None,
+        warmup: bool = True,
+    ):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.use_full_encoder = bool(use_full_encoder)
+        self.input_shape = tuple(input_shape)
+        self.buckets = make_buckets(self.max_batch)
+        self.metrics = metrics
+        self._warm: set[int] = set()
+        # one committed device copy of the variables, shared by every bucket
+        # program — per-request device_put of the params would dominate the
+        # forward at small batches
+        self._params = jax.device_put(variables["params"])
+        self._batch_stats = jax.device_put(variables.get("batch_stats", {}))
+
+        def forward(params, batch_stats, images):
+            x = to_float(images)
+            vs = {"params": params, "batch_stats": batch_stats}
+            if self.use_full_encoder:
+                return model.apply(vs, x, train=False).astype(jnp.float32)
+            return model.apply(
+                vs, x, train=False, method=model.encode
+            ).astype(jnp.float32)
+
+        # jit's shape-keyed executable cache IS the bucket compile cache:
+        # padding constrains every call to one of `buckets` shapes, warmup
+        # populates each entry, and self._warm tracks which buckets have a
+        # compiled program (the hit/miss metric).
+        self._fwd = jax.jit(forward)
+        if warmup:
+            self.warmup()
+
+    # -- lifecycle ---------------------------------------------------------
+    def warmup(self) -> dict[int, float]:
+        """Compile every bucket before traffic; returns per-bucket seconds.
+
+        Fenced with :func:`utils.profiling.synchronize` so the timing (and
+        the readiness it implies) reflects finished device work, not queued
+        dispatches.
+        """
+        times: dict[int, float] = {}
+        for b in self.buckets:
+            if b in self._warm:
+                continue
+            t0 = time.perf_counter()
+            out = self._fwd(
+                self._params,
+                self._batch_stats,
+                np.zeros((b, *self.input_shape), np.uint8),
+            )
+            synchronize(out)
+            times[b] = time.perf_counter() - t0
+            self._warm.add(b)
+        return times
+
+    # -- request path ------------------------------------------------------
+    def bucket_for(self, n_rows: int) -> int:
+        """Smallest bucket holding ``n_rows``; raises past ``max_batch``."""
+        if n_rows < 1:
+            raise ValueError(f"need at least one row, got {n_rows}")
+        if n_rows > self.max_batch:
+            raise RequestTooLargeError(
+                f"{n_rows} rows exceeds serve.max_batch={self.max_batch}; "
+                f"split the request"
+            )
+        for b in self.buckets:
+            if b >= n_rows:
+                return b
+        raise AssertionError("unreachable: buckets end at max_batch")
+
+    def embed(self, images: np.ndarray) -> np.ndarray:
+        """Embed ``(n, *input_shape)`` uint8 rows; returns ``(n, d)`` float32.
+
+        Pads up to the bucket, runs the warm program, slices the padding
+        back off. Zero-padding is sound because the frozen forward is
+        row-independent (eval-mode BN uses running statistics), so the
+        padded rows cannot perturb the real ones.
+        """
+        images = np.asarray(images)
+        if images.dtype != np.uint8:
+            raise ValueError(f"images must be uint8 pixels, got {images.dtype}")
+        if images.shape[1:] != self.input_shape:
+            raise ValueError(
+                f"images must be (n, {', '.join(map(str, self.input_shape))}), "
+                f"got {images.shape}"
+            )
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        if self.metrics is not None:
+            if bucket in self._warm:
+                self.metrics.compile_cache_hits_total.inc()
+            else:
+                self.metrics.compile_cache_misses_total.inc()
+        if bucket not in self._warm:
+            self._warm.add(bucket)
+        if n < bucket:
+            images = np.concatenate(
+                [images, np.zeros((bucket - n, *self.input_shape), np.uint8)]
+            )
+        t0 = time.perf_counter()
+        out = fetch(self._fwd(self._params, self._batch_stats, images))
+        if self.metrics is not None:
+            self.metrics.batches_total.inc()
+            self.metrics.batch_rows_total.inc(n)
+            self.metrics.batch_capacity_total.inc(bucket)
+            self.metrics.batch_latency_ms.observe(
+                (time.perf_counter() - t0) * 1000.0
+            )
+        return out[:n]
+
+    @property
+    def feature_dim(self) -> int:
+        """Output feature dimension (probed with a one-row forward)."""
+        return int(
+            jax.eval_shape(
+                self._fwd,
+                self._params,
+                self._batch_stats,
+                jax.ShapeDtypeStruct((1, *self.input_shape), jnp.uint8),
+            ).shape[-1]
+        )
+
+    # -- construction from a run directory ---------------------------------
+    @classmethod
+    def from_checkpoint(cls, cfg, *, metrics=None, warmup: bool = True):
+        """Restore the newest (or explicitly chosen) checkpoint of a run.
+
+        Uses eval's blessed constructor/loader so served embeddings are the
+        same features eval and save_features compute for that checkpoint.
+        Restore goes through the sha256-verified path: a truncated
+        checkpoint raises before the server ever binds its port.
+        """
+        from simclr_tpu.eval import build_eval_model, load_model_variables
+        from simclr_tpu.utils.checkpoint import latest_checkpoint
+
+        ckpt = cfg.select("serve.checkpoint")
+        if not ckpt:
+            target_dir = str(cfg.experiment.target_dir)
+            ckpt = latest_checkpoint(target_dir)
+            if ckpt is None:
+                raise FileNotFoundError(
+                    f"no checkpoints found under {target_dir!r}; set "
+                    f"experiment.target_dir or serve.checkpoint"
+                )
+        model = build_eval_model(cfg)
+        variables = load_model_variables(str(ckpt))
+        engine = cls(
+            model,
+            variables,
+            max_batch=int(cfg.serve.max_batch),
+            use_full_encoder=bool(cfg.parameter.use_full_encoder),
+            metrics=metrics,
+            warmup=warmup,
+        )
+        engine.checkpoint_path = str(ckpt)
+        return engine

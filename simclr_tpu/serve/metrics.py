@@ -1,0 +1,220 @@
+"""Serving metrics: counters, gauges, and latency summaries.
+
+Minimal, dependency-free instrumentation rendered in the Prometheus text
+exposition format (``GET /metrics``). Three primitives cover the serving
+surface:
+
+  * :class:`Counter` — monotonically increasing totals (requests, rows,
+    rejections, batches, compile-cache hits/misses);
+  * :class:`Gauge` — point-in-time values, either set explicitly or read
+    from a callback at render time (queue depth);
+  * :class:`Summary` — streaming latency quantiles (p50/p95/p99) over a
+    bounded reservoir of recent observations, plus exact ``_sum``/``_count``.
+
+Everything is thread-safe: handler threads record, the batcher worker
+records, and ``/metrics`` renders — all concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name} {self.value:g}\n"
+        )
+
+
+class Gauge:
+    """Explicit ``set()`` or a zero-arg callback sampled at render time."""
+
+    def __init__(self, name: str, help_text: str, fn: Callable[[], float] | None = None):
+        self.name = name
+        self.help = help_text
+        self._fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        """Bind a live source sampled at render time (e.g. queue.qsize)."""
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # callback target may be mid-shutdown
+                return 0.0
+        with self._lock:
+            return self._value
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value:g}\n"
+        )
+
+
+class Summary:
+    """Quantiles over a sliding reservoir of the most recent observations.
+
+    ``_sum``/``_count`` are exact over the full history; the p50/p95/p99
+    quantile lines are computed from the last ``reservoir`` observations —
+    recent-window percentiles are what a serving dashboard wants (steady
+    state, not startup-compile transients). Quantiles are linear
+    interpolations over the sorted reservoir, NaN when empty (the
+    Prometheus convention for unobserved summaries).
+    """
+
+    QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help_text: str, reservoir: int = 2048):
+        self.name = name
+        self.help = help_text
+        self._samples: deque[float] = deque(maxlen=reservoir)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(float(value))
+            self._sum += float(value)
+            self._count += 1
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return float("nan")
+        pos = q * (len(data) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(data) - 1)
+        return data[lo] + (data[hi] - data[lo]) * (pos - lo)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} summary",
+        ]
+        for q in self.QUANTILES:
+            lines.append(f'{self.name}{{quantile="{q:g}"}} {self.quantile(q):g}')
+        lines.append(f"{self.name}_sum {self.sum:g}")
+        lines.append(f"{self.name}_count {self.count:g}")
+        return "\n".join(lines) + "\n"
+
+
+class ServeMetrics:
+    """The serving stack's metric set, shared by engine, batcher, server.
+
+    Naming follows Prometheus conventions (``_total`` counters, explicit
+    units in names). ``avg_batch_fill()`` — requests coalesced per engine
+    batch — is the dynamic-batching health number: 1.0 means no coalescing
+    is happening (either no concurrency or ``max_delay_ms`` too low).
+    """
+
+    def __init__(self):
+        self.requests_total = Counter(
+            "simclr_serve_requests_total", "Embed requests accepted into the queue")
+        self.rows_total = Counter(
+            "simclr_serve_rows_total", "Image rows accepted into the queue")
+        self.rejected_total = Counter(
+            "simclr_serve_rejected_total",
+            "Embed requests rejected with backpressure (queue full)")
+        self.failed_total = Counter(
+            "simclr_serve_failed_total", "Embed requests that failed in the engine")
+        self.batches_total = Counter(
+            "simclr_serve_batches_total", "Engine batches dispatched")
+        self.batch_requests_total = Counter(
+            "simclr_serve_batch_requests_total",
+            "Requests coalesced into dispatched batches")
+        self.batch_rows_total = Counter(
+            "simclr_serve_batch_rows_total", "Rows across dispatched batches")
+        self.batch_capacity_total = Counter(
+            "simclr_serve_batch_capacity_total",
+            "Padded bucket capacity across dispatched batches (rows)")
+        self.compile_cache_hits_total = Counter(
+            "simclr_serve_compile_cache_hits_total",
+            "Engine batches whose bucket was already warm (no compile)")
+        self.compile_cache_misses_total = Counter(
+            "simclr_serve_compile_cache_misses_total",
+            "Engine batches that compiled a cold bucket")
+        self.queue_depth = Gauge(
+            "simclr_serve_queue_depth", "Requests waiting in the batcher queue")
+        self.request_latency_ms = Summary(
+            "simclr_serve_request_latency_ms",
+            "Submit-to-result latency per request (milliseconds)")
+        self.batch_latency_ms = Summary(
+            "simclr_serve_batch_latency_ms",
+            "Engine forward latency per dispatched batch (milliseconds)")
+
+    def avg_batch_fill(self) -> float:
+        """Mean requests coalesced per dispatched engine batch."""
+        batches = self.batches_total.value
+        return self.batch_requests_total.value / batches if batches else 0.0
+
+    def fill_ratio(self) -> float:
+        """Mean real-rows / padded-bucket-capacity across batches."""
+        capacity = self.batch_capacity_total.value
+        return self.batch_rows_total.value / capacity if capacity else 0.0
+
+    def render(self) -> str:
+        parts = [
+            m.render()
+            for m in (
+                self.requests_total, self.rows_total, self.rejected_total,
+                self.failed_total, self.batches_total,
+                self.batch_requests_total, self.batch_rows_total,
+                self.batch_capacity_total, self.compile_cache_hits_total,
+                self.compile_cache_misses_total, self.queue_depth,
+                self.request_latency_ms, self.batch_latency_ms,
+            )
+        ]
+        parts.append(
+            "# HELP simclr_serve_avg_batch_fill Mean requests per dispatched batch\n"
+            "# TYPE simclr_serve_avg_batch_fill gauge\n"
+            f"simclr_serve_avg_batch_fill {self.avg_batch_fill():g}\n"
+        )
+        parts.append(
+            "# HELP simclr_serve_batch_fill_ratio Mean rows over padded bucket capacity\n"
+            "# TYPE simclr_serve_batch_fill_ratio gauge\n"
+            f"simclr_serve_batch_fill_ratio {self.fill_ratio():g}\n"
+        )
+        return "".join(parts)

@@ -1,0 +1,312 @@
+"""HTTP front end: stdlib ThreadingHTTPServer JSON API over the batcher.
+
+Endpoints:
+
+  * ``POST /v1/embed`` — body ``{"instances": [image, ...]}`` where each
+    image is a nested list of uint8 pixels shaped like the engine's input
+    (CIFAR: 32x32x3). Response ``{"embeddings": [[...], ...], "model": ...}``
+    row-aligned with the instances. Errors: 400 malformed body/shape/range,
+    413 more rows than ``serve.max_batch``, 429 queue full (backpressure —
+    retry with backoff), 500 engine failure, 503 draining.
+  * ``GET /healthz`` — 200 once warm and accepting, 503 while draining.
+  * ``GET /metrics`` — Prometheus text format (``serve/metrics.py``).
+
+Shutdown contract (tested): SIGTERM (or SIGINT) flips the server into
+draining — new embeds get 503, ``/healthz`` reports draining — then the
+batcher drains (every accepted request is answered), the accept loop
+stops, in-flight handler threads are joined, and the process exits 0.
+
+JSON float fidelity: embeddings are float32; Python serializes each via
+the shortest repr of its exact double value, so a client reading the JSON
+back into float32 recovers the embedding **bitwise** — the e2e test
+asserts equality through the full HTTP round trip.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from simclr_tpu.serve.batcher import BackpressureError, BatcherClosedError
+from simclr_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+class EmbedServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the serving stack for its handlers.
+
+    ``daemon_threads=True`` with the default ``block_on_close=True``:
+    handler threads never outlive a crash, but a clean ``server_close()``
+    still joins them — required for the drain guarantee.
+    """
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address, engine, batcher, metrics, request_timeout_s=30.0):
+        super().__init__(address, EmbedHandler)
+        self.engine = engine
+        self.batcher = batcher
+        self.metrics = metrics
+        self.request_timeout_s = float(request_timeout_s)
+        self.draining = threading.Event()
+
+
+class EmbedHandler(BaseHTTPRequestHandler):
+    server: EmbedServer
+    server_version = "simclr-serve/1.0"
+    protocol_version = "HTTP/1.1"
+    # TCP_NODELAY: without it, Nagle + delayed ACK stalls small
+    # response-then-request exchanges on keep-alive connections by ~40ms —
+    # an order of magnitude over the coalescing window itself
+    disable_nagle_algorithm = True
+
+    # quiet per-request lines; keep them reachable at debug level
+    def log_message(self, fmt, *args):  # noqa: D102
+        logger.debug("http %s", fmt % args)
+
+    def _send(self, code: int, body: bytes, content_type: str, headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: dict, headers=()) -> None:
+        self._send(
+            code, json.dumps(payload).encode(), "application/json", headers
+        )
+
+    # -- GET ---------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            if self.server.draining.is_set():
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(
+                    200,
+                    {
+                        "status": "ok",
+                        "buckets": list(self.server.engine.buckets),
+                        "max_batch": self.server.engine.max_batch,
+                        "feature_dim": self.server.engine.feature_dim,
+                        "checkpoint": getattr(
+                            self.server.engine, "checkpoint_path", None
+                        ),
+                    },
+                )
+        elif self.path == "/metrics":
+            self._send(
+                200,
+                self.server.metrics.render().encode(),
+                "text/plain; version=0.0.4",
+            )
+        else:
+            self._send_json(404, {"error": f"no such path {self.path!r}"})
+
+    # -- POST --------------------------------------------------------------
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path != "/v1/embed":
+            self._send_json(404, {"error": f"no such path {self.path!r}"})
+            return
+        if self.server.draining.is_set():
+            self._send_json(
+                503, {"error": "server is draining"}, [("Retry-After", "1")]
+            )
+            return
+        try:
+            images = self._parse_instances()
+        except _BadRequest as e:
+            self._send_json(e.code, {"error": str(e)})
+            return
+        try:
+            future = self.server.batcher.submit(images)
+        except BackpressureError as e:
+            self._send_json(429, {"error": str(e)}, [("Retry-After", "1")])
+            return
+        except BatcherClosedError as e:
+            self._send_json(503, {"error": str(e)}, [("Retry-After", "1")])
+            return
+        try:
+            embeddings = future.result(timeout=self.server.request_timeout_s)
+        except (TimeoutError, _FutureTimeout):
+            self._send_json(
+                504,
+                {"error": f"embed timed out after {self.server.request_timeout_s}s"},
+            )
+            return
+        except BatcherClosedError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        except Exception as e:  # engine failure — already counted by batcher
+            self._send_json(500, {"error": repr(e)})
+            return
+        self._send_json(
+            200,
+            {
+                "embeddings": [
+                    [float(v) for v in row] for row in np.asarray(embeddings)
+                ],
+            },
+        )
+
+    def _parse_instances(self) -> np.ndarray:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise _BadRequest("missing request body")
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise _BadRequest(f"body is not valid JSON: {e}") from None
+        if not isinstance(payload, dict) or "instances" not in payload:
+            raise _BadRequest('body must be a JSON object with "instances"')
+        engine = self.server.engine
+        try:
+            images = np.asarray(payload["instances"])
+        except (ValueError, TypeError) as e:
+            raise _BadRequest(f"instances are not a rectangular array: {e}") from None
+        if images.ndim != 1 + len(engine.input_shape) or (
+            images.shape[1:] != engine.input_shape
+        ):
+            raise _BadRequest(
+                f"instances must be shaped (n, "
+                f"{', '.join(map(str, engine.input_shape))}), got {images.shape}"
+            )
+        if images.shape[0] < 1:
+            raise _BadRequest("instances must carry at least one image")
+        if images.shape[0] > engine.max_batch:
+            raise _BadRequest(
+                f"{images.shape[0]} instances exceeds max_batch="
+                f"{engine.max_batch}; split the request",
+                code=413,
+            )
+        if not np.issubdtype(images.dtype, np.integer):
+            raise _BadRequest(f"pixels must be integers 0..255, got {images.dtype}")
+        if images.min() < 0 or images.max() > 255:
+            raise _BadRequest("pixel values must be uint8 (0..255)")
+        return images.astype(np.uint8)
+
+
+class _BadRequest(ValueError):
+    def __init__(self, message: str, code: int = 400):
+        super().__init__(message)
+        self.code = code
+
+
+def run_server(cfg) -> int:
+    """Build the stack from ``cfg``, serve until SIGTERM/SIGINT, drain, 0.
+
+    The ``python -m simclr_tpu.serve`` body, also callable in-process (the
+    e2e tests drive it via :func:`start_server` below instead, which skips
+    the signal wiring the test process cannot own).
+    """
+    from simclr_tpu.config import check_serve_conf
+    from simclr_tpu.serve.engine import EmbedEngine
+    from simclr_tpu.serve.metrics import ServeMetrics
+
+    check_serve_conf(cfg)
+    metrics = ServeMetrics()
+    logger.info("restoring checkpoint and warming buckets...")
+    engine = EmbedEngine.from_checkpoint(cfg, metrics=metrics, warmup=False)
+    warm_times = engine.warmup()
+    logger.info(
+        "warmed %d bucket programs (max_batch=%d): %s",
+        len(warm_times), engine.max_batch,
+        " ".join(f"b{b}={t:.2f}s" for b, t in sorted(warm_times.items())),
+    )
+    server, _batcher = start_server(
+        cfg, engine=engine, metrics=metrics
+    )
+
+    def _terminate(signum, frame):
+        # shutdown() must not run on the serve_forever thread (it blocks on
+        # the loop stopping); hand the drain to a helper thread and return
+        # from the handler immediately
+        logger.info("signal %d: draining...", signum)
+        threading.Thread(
+            target=shutdown_gracefully, args=(server,), daemon=True
+        ).start()
+
+    previous = {
+        sig: signal.signal(sig, _terminate)
+        for sig in (signal.SIGTERM, signal.SIGINT)
+    }
+    try:
+        logger.info(
+            "serving embeddings on http://%s:%d (POST /v1/embed)",
+            *server.server_address[:2],
+        )
+        _write_ready_file(cfg, server)
+        server.serve_forever(poll_interval=0.1)
+    finally:
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+        server.server_close()  # joins in-flight handler threads
+    logger.info("drained; exiting 0")
+    return 0
+
+
+def start_server(cfg, *, engine=None, metrics=None) -> tuple:
+    """Construct (EmbedServer, DynamicBatcher) bound to ``serve.host:port``
+    without entering the accept loop — the embeddable/testable core of
+    :func:`run_server`. Caller runs ``serve_forever`` and later
+    :func:`shutdown_gracefully`."""
+    from simclr_tpu.serve.batcher import DynamicBatcher
+    from simclr_tpu.serve.engine import EmbedEngine
+    from simclr_tpu.serve.metrics import ServeMetrics
+
+    metrics = metrics if metrics is not None else ServeMetrics()
+    if engine is None:
+        engine = EmbedEngine.from_checkpoint(cfg, metrics=metrics)
+    batcher = DynamicBatcher(
+        engine.embed,
+        max_batch=engine.max_batch,
+        max_delay_ms=float(cfg.serve.max_delay_ms),
+        queue_depth=int(cfg.serve.queue_depth),
+        metrics=metrics,
+    )
+    server = EmbedServer(
+        (str(cfg.serve.host), int(cfg.serve.port)),
+        engine,
+        batcher,
+        metrics,
+        request_timeout_s=float(cfg.serve.request_timeout_s),
+    )
+    return server, batcher
+
+
+def shutdown_gracefully(server: EmbedServer, drain_timeout_s: float = 30.0) -> None:
+    """Drain-then-stop, idempotent: 503 new work, answer everything
+    accepted, stop the accept loop."""
+    if server.draining.is_set():
+        return
+    server.draining.set()
+    server.batcher.close(drain=True, timeout=drain_timeout_s)
+    server.shutdown()
+
+
+def _write_ready_file(cfg, server: EmbedServer) -> None:
+    """Publish the bound address (``serve.ready_file``) — how orchestration
+    and the SIGTERM e2e test learn an ephemeral port (``serve.port=0``)."""
+    import os
+
+    path = cfg.select("serve.ready_file")
+    if not path:
+        return
+    from simclr_tpu.utils.ioutil import atomic_write
+
+    host, port = server.server_address[:2]
+    atomic_write(
+        str(path),
+        lambda f: json.dump(
+            {"host": host, "port": port, "pid": os.getpid()}, f
+        ),
+    )

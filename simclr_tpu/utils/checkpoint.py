@@ -17,13 +17,51 @@ eval/save_features enumerates them the same way the reference globs ``*.pt``
 
 from __future__ import annotations
 
+import hashlib
 import os
 import re
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 _EPOCH_RE = re.compile(r"epoch=(\d+)-")
+
+# integrity sidecar written next to each checkpoint directory: sha256 over
+# every file the checkpoint contains, so a consumer (the serving engine
+# above all — it must never answer traffic from a truncated restore) can
+# verify the bytes on disk are the bytes that were saved
+DIGEST_SUFFIX = ".sha256"
+
+
+def digest_path(path: str) -> str:
+    """Sidecar path for a checkpoint directory (``<path>.sha256``)."""
+    return path.rstrip("/") + DIGEST_SUFFIX
+
+
+def checkpoint_digest(path: str) -> str:
+    """sha256 hex digest over a checkpoint directory's full contents.
+
+    Hashes every regular file in sorted relative-path order, framing each
+    with its path and size so file renames, truncations, and content swaps
+    all change the digest. Deterministic across hosts: orbax writes the
+    same bytes it later reads, and the walk order is sorted, not
+    filesystem-dependent.
+    """
+    h = hashlib.sha256()
+    path = os.path.abspath(path)
+    files = []
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            full = os.path.join(root, name)
+            files.append((os.path.relpath(full, path), full))
+    for rel, full in sorted(files):
+        size = os.path.getsize(full)
+        h.update(f"{rel}\x00{size}\x00".encode())
+        with open(full, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()
 
 
 def checkpoint_name(epoch: int, output_model_name: str) -> str:
@@ -53,8 +91,12 @@ def list_checkpoints(target_dir: str) -> list[str]:
         full = os.path.join(target_dir, entry)
         # skip orbax's in-progress tmp dirs (name carries the final dir's
         # "epoch=" prefix): a crash mid-save must not offer a half-written
-        # checkpoint to resume/eval
+        # checkpoint to resume/eval. Integrity sidecars and atomic_write
+        # temp files also carry the "epoch=" prefix and must never be
+        # enumerated as checkpoints (they are files, but be explicit).
         if "orbax-checkpoint-tmp" in entry:
+            continue
+        if entry.endswith(DIGEST_SUFFIX) or ".tmp." in entry:
             continue
         if os.path.isdir(full) and _EPOCH_RE.search(entry):
             out.append(full)
@@ -72,29 +114,101 @@ def list_checkpoints_or_raise(target_dir: str) -> list[str]:
 
 
 def save_checkpoint(path: str, state) -> None:
-    """Save a pytree (TrainState or plain dict) to ``path`` atomically."""
+    """Save a pytree (TrainState or plain dict) to ``path`` atomically.
+
+    After the orbax save commits, process 0 writes a sha256 sidecar
+    (``<path>.sha256``, via ``ioutil.atomic_write`` so a crash leaves either
+    no sidecar or a complete one) that :func:`restore_checkpoint` verifies
+    before trusting the bytes — a truncated or bit-rotted checkpoint fails
+    loudly at load instead of silently serving garbage embeddings.
+    """
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state, force=True)
+    if jax.process_index() == 0:
+        from simclr_tpu.utils.ioutil import atomic_write
+
+        digest = checkpoint_digest(path)
+        atomic_write(
+            digest_path(path),
+            lambda f: f.write(f"{digest}  {os.path.basename(path)}\n"),
+        )
 
 
-def restore_checkpoint(path: str, target=None):
+class CheckpointCorruptionError(ValueError):
+    """The on-disk checkpoint bytes do not match their recorded digest."""
+
+
+def verify_checkpoint(path: str) -> bool:
+    """Check ``path`` against its sha256 sidecar.
+
+    Returns True when the digest matches, False when no sidecar exists (a
+    legacy checkpoint saved before integrity sidecars landed — callers
+    warn, not fail), and raises :class:`CheckpointCorruptionError` on a
+    mismatch or an unparseable sidecar.
+    """
+    sidecar = digest_path(os.path.abspath(path))
+    if not os.path.exists(sidecar):
+        return False
+    with open(sidecar) as f:
+        recorded = f.read().split()
+    if not recorded or len(recorded[0]) != 64:
+        raise CheckpointCorruptionError(
+            f"unparseable checkpoint digest sidecar {sidecar!r}"
+        )
+    actual = checkpoint_digest(path)
+    if actual != recorded[0]:
+        raise CheckpointCorruptionError(
+            f"checkpoint {path!r} does not match its recorded sha256 "
+            f"(recorded {recorded[0][:12]}…, actual {actual[:12]}…): the "
+            f"checkpoint is truncated or corrupt — do not resume or serve "
+            f"from it"
+        )
+    return True
+
+
+def restore_checkpoint(path: str, target=None, *, verify: bool = True):
     """Restore into the structure/shardings of ``target``; with ``target=None``
-    return the raw pytree (dict of numpy arrays) — the eval/export load path."""
+    return the raw pytree (dict of numpy arrays) — the eval/export load path.
+
+    With ``verify=True`` (default) the sha256 sidecar is checked first when
+    present; legacy checkpoints without a sidecar load with a warning.
+    """
     path = os.path.abspath(path)
+    if verify:
+        if not verify_checkpoint(path):
+            from simclr_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "checkpoint %s has no sha256 sidecar (saved before integrity "
+                "sidecars landed); loading unverified", path,
+            )
+    if target is None:
+        # Host-numpy restore, independent of the saving topology: the
+        # StandardCheckpointer default re-applies the SAVED shardings, so a
+        # checkpoint written on an 8-device mesh refuses to load in a
+        # single-device process (train on a pod, serve/eval on one chip).
+        with ocp.PyTreeCheckpointer() as ckptr:
+            meta = ckptr.metadata(path)
+            tree = getattr(meta, "tree", None) or meta
+            restore_args = jax.tree.map(
+                lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+            )
+            return ckptr.restore(path, restore_args=restore_args)
     with ocp.StandardCheckpointer() as ckptr:
-        if target is None:
-            return ckptr.restore(path)
         return ckptr.restore(path, target)
 
 
 def delete_checkpoint(path: str) -> None:
-    """Remove a checkpoint directory (the supervised best-only policy,
-    ``/root/reference/supervised.py:151-162``)."""
+    """Remove a checkpoint directory and its digest sidecar (the supervised
+    best-only policy, ``/root/reference/supervised.py:151-162``)."""
     import shutil
 
     if os.path.isdir(path):
         shutil.rmtree(path)
+    sidecar = digest_path(path)
+    if os.path.exists(sidecar):
+        os.unlink(sidecar)
 
 
 def latest_checkpoint(save_dir: str) -> str | None:

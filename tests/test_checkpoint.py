@@ -1,21 +1,26 @@
-"""Orbax checkpoint round-trip + naming-scheme tests."""
+"""Orbax checkpoint round-trip + naming-scheme + integrity-sidecar tests."""
 
 import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from simclr_tpu.ops.lars import lars
 from simclr_tpu.parallel.train_state import TrainState
 from simclr_tpu.utils.checkpoint import (
+    CheckpointCorruptionError,
+    checkpoint_digest,
     checkpoint_name,
     delete_checkpoint,
+    digest_path,
     epoch_of,
     latest_checkpoint,
     list_checkpoints,
     restore_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
 )
 
 
@@ -79,3 +84,147 @@ class TestRoundTrip:
         assert epoch_of(latest) == 2
         delete_checkpoint(latest)
         assert epoch_of(latest_checkpoint(str(tmp_path))) == 1
+
+
+class TestCrossTopologyRestore:
+    def test_mesh_saved_checkpoint_restores_on_one_device(self, tmp_path):
+        """A checkpoint saved with arrays sharded over the 8-device mesh must
+        load in a single-device process (train on a pod, serve/eval on one
+        chip): the raw restore path materializes to host numpy instead of
+        re-applying the saved shardings."""
+        import subprocess
+        import sys
+        import textwrap
+
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        params = {
+            "dense": {
+                "kernel": jax.device_put(
+                    jnp.arange(16, dtype=jnp.float32).reshape(8, 2),
+                    NamedSharding(mesh, PartitionSpec("data", None)),
+                ),
+                "bias": jnp.zeros(2),
+            }
+        }
+        tx = lars(0.1)
+        state = TrainState(
+            step=jnp.asarray(3, jnp.int32),
+            params=params,
+            batch_stats={"bn": {"mean": jnp.ones(2)}},
+            opt_state=tx.init(params),
+        )
+        path = str(tmp_path / "epoch=3-m")
+        save_checkpoint(path, state)
+
+        code = textwrap.dedent(
+            f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            from simclr_tpu.utils.checkpoint import restore_checkpoint
+            assert jax.device_count() == 1, jax.device_count()
+            raw = restore_checkpoint({path!r})
+            kernel = np.asarray(raw["params"]["dense"]["kernel"])
+            np.testing.assert_array_equal(
+                kernel, np.arange(16, dtype=np.float32).reshape(8, 2)
+            )
+            print("OK")
+            """
+        )
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout
+
+
+class TestIntegrity:
+    def test_save_writes_sidecar_and_verify_round_trips(self, tmp_path):
+        path = str(tmp_path / "epoch=1-m")
+        save_checkpoint(path, _tiny_state())
+        sidecar = digest_path(path)
+        assert os.path.exists(sidecar)
+        with open(sidecar) as f:
+            recorded = f.read().split()
+        assert recorded[0] == checkpoint_digest(path)
+        assert len(recorded[0]) == 64
+        assert verify_checkpoint(path) is True
+        restore_checkpoint(path, _tiny_state())  # verified load succeeds
+
+    def test_corruption_is_detected(self, tmp_path):
+        path = str(tmp_path / "epoch=1-m")
+        save_checkpoint(path, _tiny_state())
+        # flip bytes in some checkpoint payload file
+        victim = None
+        for root, _dirs, names in os.walk(path):
+            for name in names:
+                full = os.path.join(root, name)
+                if os.path.getsize(full) > 0:
+                    victim = full
+        assert victim is not None
+        with open(victim, "r+b") as f:
+            first = f.read(1)
+            f.seek(0)
+            f.write(bytes([first[0] ^ 0xFF]))
+        with pytest.raises(CheckpointCorruptionError, match="sha256"):
+            verify_checkpoint(path)
+        with pytest.raises(CheckpointCorruptionError):
+            restore_checkpoint(path)
+
+    def test_truncation_is_detected(self, tmp_path):
+        path = str(tmp_path / "epoch=1-m")
+        save_checkpoint(path, _tiny_state())
+        largest = max(
+            (os.path.join(r, n) for r, _d, ns in os.walk(path) for n in ns),
+            key=os.path.getsize,
+        )
+        with open(largest, "r+b") as f:
+            f.truncate(max(os.path.getsize(largest) - 1, 0))
+        with pytest.raises(CheckpointCorruptionError):
+            verify_checkpoint(path)
+
+    def test_legacy_checkpoint_without_sidecar_loads_with_warning(self, tmp_path):
+        path = str(tmp_path / "epoch=1-m")
+        save_checkpoint(path, _tiny_state(seed=4))
+        os.unlink(digest_path(path))
+        assert verify_checkpoint(path) is False  # legacy: absent, not corrupt
+        raw = restore_checkpoint(path)  # warn-only, still restores
+        np.testing.assert_array_equal(
+            np.asarray(raw["params"]["dense"]["kernel"]), np.full((4, 2), 4.0)
+        )
+
+    def test_unparseable_sidecar_is_corruption(self, tmp_path):
+        path = str(tmp_path / "epoch=1-m")
+        save_checkpoint(path, _tiny_state())
+        with open(digest_path(path), "w") as f:
+            f.write("not-a-digest\n")
+        with pytest.raises(CheckpointCorruptionError, match="unparseable"):
+            verify_checkpoint(path)
+
+    def test_sidecars_never_enumerate_as_checkpoints(self, tmp_path):
+        for e in (1, 2):
+            save_checkpoint(str(tmp_path / f"epoch={e}-m"), _tiny_state(e))
+        listed = list_checkpoints(str(tmp_path))
+        assert [epoch_of(p) for p in listed] == [1, 2]
+        assert not any(p.endswith(".sha256") for p in listed)
+        assert epoch_of(latest_checkpoint(str(tmp_path))) == 2
+
+    def test_delete_removes_sidecar(self, tmp_path):
+        path = str(tmp_path / "epoch=1-m")
+        save_checkpoint(path, _tiny_state())
+        delete_checkpoint(path)
+        assert not os.path.exists(path)
+        assert not os.path.exists(digest_path(path))
+
+    def test_digest_depends_on_content_and_layout(self, tmp_path):
+        a, b = str(tmp_path / "epoch=1-m"), str(tmp_path / "epoch=2-m")
+        save_checkpoint(a, _tiny_state(seed=1))
+        save_checkpoint(b, _tiny_state(seed=2))
+        assert checkpoint_digest(a) != checkpoint_digest(b)

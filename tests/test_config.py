@@ -7,6 +7,7 @@ from simclr_tpu.config import (
     ConfigError,
     check_eval_conf,
     check_pretrain_conf,
+    check_serve_conf,
     load_config,
     resolve_save_dir,
 )
@@ -77,6 +78,29 @@ def test_validation_rejects_bad_values():
     ev.parameter.classifier = "svm"
     with pytest.raises(ConfigError):
         check_eval_conf(ev)
+
+
+def test_serve_config_defaults_and_validation():
+    cfg = load_config("serve")
+    assert cfg.serve.max_batch == 256
+    assert cfg.serve.max_delay_ms == 5.0
+    assert cfg.serve.queue_depth == 64
+    assert cfg.serve.checkpoint is None
+    with pytest.raises(ConfigError):  # no checkpoint AND DUMMY-PATH target
+        check_serve_conf(cfg)
+    cfg.experiment.target_dir = "/tmp/ckpts"
+    check_serve_conf(cfg)
+    cfg.serve.max_batch = 0
+    with pytest.raises(ConfigError):
+        check_serve_conf(cfg)
+    cfg.serve.max_batch = 256
+    cfg.serve.port = 70000
+    with pytest.raises(ConfigError):
+        check_serve_conf(cfg)
+    cfg.serve.port = 0
+    cfg.experiment.target_dir = "DUMMY-PATH"
+    cfg.serve.checkpoint = "/tmp/ckpts/epoch=1-m"  # explicit checkpoint suffices
+    check_serve_conf(cfg)
 
 
 def test_bad_override_syntax_raises():

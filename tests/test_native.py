@@ -72,3 +72,45 @@ class TestPrefetcher:
         batches = list(prefetch(it.batches(0)))
         assert len(batches) == 4
         assert batches[0]["image"].shape == (16, 32, 32, 3)
+
+    def test_error_reaches_consumer_past_a_full_queue(self):
+        # depth-1 queue already holding an item when the worker dies: the
+        # termination sentinel is dropped on the full queue, and __next__
+        # must fall back to the done flag to surface the error rather than
+        # poll forever
+        def gen():
+            yield 1
+            yield 2
+            raise RuntimeError("late boom")
+
+        it = prefetch(gen(), depth=1)
+        got = []
+        with pytest.raises(RuntimeError, match="late boom"):
+            for item in it:
+                got.append(item)
+        assert got == [1, 2]  # batches produced before the failure are valid
+
+    def test_close_returns_promptly_with_wedged_producer(self):
+        import threading
+        import time
+
+        release = threading.Event()
+
+        def gen():
+            yield 0
+            release.wait(timeout=30)  # a hung transfer, effectively
+            yield 1
+
+        it = prefetch(gen(), depth=1)
+        assert next(it) == 0
+        t0 = time.monotonic()
+        it.close(timeout=0.5)
+        assert time.monotonic() - t0 < 5  # bounded even though the producer hangs
+        release.set()
+
+    def test_close_idempotent_after_exhaustion(self):
+        it = prefetch(iter(range(3)))
+        assert list(it) == [0, 1, 2]
+        it.close()
+        it.close()
+        assert not it._thread.is_alive()

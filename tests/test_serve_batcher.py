@@ -1,0 +1,198 @@
+"""DynamicBatcher unit tests: coalescing, backpressure, drain, abort.
+
+Pure threading tests — the engine is a fake ``embed_fn``, no jax involved.
+The fake is gated on an Event so tests control exactly which requests are
+queued when the worker dispatches, making coalescing assertions
+deterministic instead of timing-dependent.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from simclr_tpu.serve.batcher import (
+    BackpressureError,
+    BatcherClosedError,
+    DynamicBatcher,
+)
+from simclr_tpu.serve.metrics import ServeMetrics
+
+pytestmark = pytest.mark.serve
+
+D = 4
+
+
+def rows(n: int, tag: float = 0.0) -> np.ndarray:
+    """(n, 1) request payload whose values identify the request."""
+    return np.full((n, 1), tag, np.float32)
+
+
+def embed_identity(images: np.ndarray) -> np.ndarray:
+    """Fake engine: (n, 1) in -> (n, D) out, row i = input row i broadcast."""
+    return np.repeat(np.asarray(images, np.float32), D, axis=1)
+
+
+class GatedEmbed:
+    """embed_fn that blocks on ``gate`` and records every call's batch."""
+
+    def __init__(self, gate_first_n: int = 1):
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+        self.calls: list[np.ndarray] = []
+        self._gated_remaining = gate_first_n
+        self._lock = threading.Lock()
+
+    def __call__(self, images):
+        with self._lock:
+            gated = self._gated_remaining > 0
+            if gated:
+                self._gated_remaining -= 1
+        self.calls.append(np.asarray(images))
+        if gated:
+            self.entered.set()
+            assert self.gate.wait(timeout=10), "test never released the gate"
+        return embed_identity(images)
+
+
+class TestCoalescing:
+    def test_queued_requests_coalesce_into_one_batch(self):
+        embed = GatedEmbed()
+        metrics = ServeMetrics()
+        with DynamicBatcher(
+            embed, max_batch=16, max_delay_ms=50, queue_depth=16, metrics=metrics
+        ) as b:
+            f0 = b.submit(rows(1, tag=0))
+            assert embed.entered.wait(timeout=5)  # worker blocked inside call 1
+            futures = [b.submit(rows(2, tag=i)) for i in (1, 2, 3)]
+            embed.gate.set()
+            results = [f.result(timeout=5) for f in [f0, *futures]]
+        # call 1 = the solo opener; call 2 = the three queued requests coalesced
+        assert [c.shape[0] for c in embed.calls] == [1, 6]
+        for tag, out in enumerate(results):
+            np.testing.assert_array_equal(out, embed_identity(rows(out.shape[0], tag)))
+        # batches_total is the engine's metric; the batcher records how many
+        # requests it coalesced into each dispatch
+        assert metrics.batch_requests_total.value == 4
+        assert metrics.requests_total.value == 4
+        assert metrics.rows_total.value == 7
+
+    def test_request_overflowing_max_batch_carries_to_next_batch(self):
+        embed = GatedEmbed()
+        with DynamicBatcher(embed, max_batch=4, max_delay_ms=50, queue_depth=16) as b:
+            f0 = b.submit(rows(1))
+            assert embed.entered.wait(timeout=5)
+            f1 = b.submit(rows(3))  # fills batch 2 exactly
+            f2 = b.submit(rows(2))  # would overflow -> must open batch 3
+            embed.gate.set()
+            for f in (f0, f1, f2):
+                f.result(timeout=5)
+        assert [c.shape[0] for c in embed.calls] == [1, 3, 2]
+
+    def test_single_request_dispatches_without_concat(self):
+        with DynamicBatcher(embed_identity, max_batch=8, max_delay_ms=0) as b:
+            out = b.submit(rows(3, tag=7)).result(timeout=5)
+        np.testing.assert_array_equal(out, embed_identity(rows(3, tag=7)))
+
+
+class TestBackpressure:
+    def test_full_queue_rejects_with_backpressure(self):
+        embed = GatedEmbed()
+        metrics = ServeMetrics()
+        b = DynamicBatcher(
+            embed, max_batch=4, max_delay_ms=0, queue_depth=2, metrics=metrics
+        )
+        try:
+            accepted = [b.submit(rows(1))]
+            assert embed.entered.wait(timeout=5)
+            accepted += [b.submit(rows(1)), b.submit(rows(1))]  # queue now full
+            with pytest.raises(BackpressureError):
+                b.submit(rows(1))
+            assert metrics.rejected_total.value == 1
+            assert metrics.requests_total.value == 3
+            embed.gate.set()
+            for f in accepted:  # rejection never costs an accepted request
+                assert f.result(timeout=5).shape == (1, D)
+        finally:
+            embed.gate.set()
+            b.close()
+
+    def test_submit_validates_row_count(self):
+        with DynamicBatcher(embed_identity, max_batch=4, max_delay_ms=0) as b:
+            with pytest.raises(ValueError, match="1..4"):
+                b.submit(rows(5))
+            with pytest.raises(ValueError, match="1..4"):
+                b.submit(np.zeros((0, 1), np.float32))
+
+
+class TestShutdown:
+    def test_drain_answers_everything_accepted(self):
+        embed = GatedEmbed()
+        b = DynamicBatcher(embed, max_batch=2, max_delay_ms=0, queue_depth=16)
+        futures = [b.submit(rows(1, tag=i)) for i in range(6)]
+        assert embed.entered.wait(timeout=5)
+        embed.gate.set()
+        assert b.close(drain=True, timeout=10) is True
+        for i, f in enumerate(futures):
+            np.testing.assert_array_equal(f.result(timeout=0), embed_identity(rows(1, i)))
+
+    def test_abort_fails_queued_futures(self):
+        embed = GatedEmbed()
+        b = DynamicBatcher(embed, max_batch=1, max_delay_ms=0, queue_depth=16)
+        f0 = b.submit(rows(1))
+        assert embed.entered.wait(timeout=5)
+        queued = [b.submit(rows(1)) for _ in range(3)]
+        embed.gate.set()
+        assert b.close(drain=False, timeout=10) is True
+        f0.result(timeout=5)  # the in-flight dispatch still completes
+        for f in queued:
+            with pytest.raises(BatcherClosedError):
+                f.result(timeout=5)
+
+    def test_submit_after_close_raises(self):
+        b = DynamicBatcher(embed_identity, max_batch=4)
+        b.close()
+        with pytest.raises(BatcherClosedError):
+            b.submit(rows(1))
+
+    def test_drain_overrun_falls_back_to_abort(self):
+        def wedged(images):
+            time.sleep(30)
+            return embed_identity(images)
+
+        b = DynamicBatcher(wedged, max_batch=1, max_delay_ms=0, queue_depth=4)
+        b.submit(rows(1))
+        time.sleep(0.1)  # let the worker enter the wedged call
+        t0 = time.monotonic()
+        assert b.close(drain=True, timeout=0.3) is False  # daemon thread stays wedged
+        assert time.monotonic() - t0 < 5  # ...but close() itself returns promptly
+
+
+class TestErrors:
+    def test_engine_exception_reaches_every_caller_then_recovers(self):
+        metrics = ServeMetrics()
+        state = {"fail": True}
+
+        def flaky(images):
+            if state["fail"]:
+                state["fail"] = False
+                raise RuntimeError("engine exploded")
+            return embed_identity(images)
+
+        with DynamicBatcher(
+            flaky, max_batch=8, max_delay_ms=0, metrics=metrics
+        ) as b:
+            with pytest.raises(RuntimeError, match="engine exploded"):
+                b.submit(rows(2)).result(timeout=5)
+            assert metrics.failed_total.value == 1
+            # the worker survives an engine failure and serves the next request
+            assert b.submit(rows(2)).result(timeout=5).shape == (2, D)
+
+    def test_constructor_validates_knobs(self):
+        with pytest.raises(ValueError):
+            DynamicBatcher(embed_identity, max_batch=0)
+        with pytest.raises(ValueError):
+            DynamicBatcher(embed_identity, max_delay_ms=-1)
+        with pytest.raises(ValueError):
+            DynamicBatcher(embed_identity, queue_depth=0)

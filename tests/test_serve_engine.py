@@ -1,0 +1,176 @@
+"""EmbedEngine tests: bucket math, padding exactness, warmup, metrics.
+
+The load-bearing property is **padding exactness**: a request of n rows is
+served through the padded power-of-two bucket program, and the rows that
+come back must be BITWISE identical to an independently-jitted forward of
+the same rows at the same bucket shape — zero-padding and slicing must be
+invisible. (Bitwise equality across *different* batch shapes is not an XLA
+guarantee — batch-1 programs can lower matmuls down a different codegen
+path — so the reference is always computed at the bucket shape the engine
+actually ran; against the unpadded n-row shape we assert allclose.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simclr_tpu.data.augment import to_float
+from simclr_tpu.serve.engine import EmbedEngine, RequestTooLargeError, make_buckets
+from simclr_tpu.serve.metrics import ServeMetrics
+
+from tests.helpers import TinyContrastive, random_images
+
+pytestmark = pytest.mark.serve
+
+MAX_BATCH = 8
+
+
+def tiny_model_and_variables(d: int = 8, seed: int = 0):
+    # bn axis None: the engine is single-device by design, no mesh to psum over
+    model = TinyContrastive(bn_cross_replica_axis=None)
+    variables = jax.tree.map(
+        np.asarray, model.init(jax.random.key(seed), jnp.zeros((2, 32, 32, 3)))
+    )
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model, variables = tiny_model_and_variables()
+    return EmbedEngine(model, variables, max_batch=MAX_BATCH, metrics=ServeMetrics())
+
+
+def reference_forward(engine, images: np.ndarray) -> np.ndarray:
+    """Independently-jitted eval forward at exactly ``images.shape`` —
+    what the engine must reproduce bitwise at the bucket shape."""
+    model = engine.model
+
+    @jax.jit
+    def fwd(params, batch_stats, x):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            to_float(x), train=False, method=model.encode,
+        ).astype(jnp.float32)
+
+    return np.asarray(fwd(engine._params, engine._batch_stats, images))
+
+
+class TestBuckets:
+    def test_make_buckets_power_of_two(self):
+        assert make_buckets(1) == (1,)
+        assert make_buckets(8) == (1, 2, 4, 8)
+        assert make_buckets(256) == (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+    def test_make_buckets_non_power_of_two_ceiling(self):
+        # the configured ceiling is always exactly servable
+        assert make_buckets(24) == (1, 2, 4, 8, 16, 24)
+        assert make_buckets(3) == (1, 2, 3)
+
+    def test_make_buckets_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            make_buckets(0)
+
+    def test_bucket_for(self, engine):
+        assert engine.bucket_for(1) == 1
+        assert engine.bucket_for(3) == 4
+        assert engine.bucket_for(MAX_BATCH) == MAX_BATCH
+        with pytest.raises(ValueError):
+            engine.bucket_for(0)
+        with pytest.raises(RequestTooLargeError):
+            engine.bucket_for(MAX_BATCH + 1)
+
+
+class TestPaddingExactness:
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, MAX_BATCH])
+    def test_served_rows_match_bucket_forward_bitwise(self, engine, n):
+        images = random_images(n, seed=n)
+        served = engine.embed(images)
+        assert served.shape == (n, engine.feature_dim)
+        assert served.dtype == np.float32
+        bucket = engine.bucket_for(n)
+        padded = np.concatenate(
+            [images, np.zeros((bucket - n, 32, 32, 3), np.uint8)]
+        )
+        np.testing.assert_array_equal(served, reference_forward(engine, padded)[:n])
+
+    @pytest.mark.parametrize("n", [3, 5])
+    def test_padded_rows_close_to_unpadded_forward(self, engine, n):
+        # across shapes only allclose holds (different XLA programs)
+        images = random_images(n, seed=100 + n)
+        np.testing.assert_allclose(
+            engine.embed(images), reference_forward(engine, images),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_padding_rows_do_not_leak_into_real_rows(self, engine):
+        # same rows served at n=3 (bucket 4) with different garbage beyond
+        # row 3 must give identical answers: row independence of the frozen
+        # forward is what makes zero-padding sound
+        images = random_images(4, seed=9)
+        a = engine.embed(images[:3])
+        b = engine.embed(np.concatenate([images[:3], images[3:4]]))[:3]
+        np.testing.assert_array_equal(a, b)
+
+
+class TestValidation:
+    def test_rejects_non_uint8(self, engine):
+        with pytest.raises(ValueError, match="uint8"):
+            engine.embed(np.zeros((2, 32, 32, 3), np.float32))
+
+    def test_rejects_wrong_shape(self, engine):
+        with pytest.raises(ValueError, match="32, 32, 3"):
+            engine.embed(np.zeros((2, 16, 16, 3), np.uint8))
+
+    def test_rejects_oversize_request(self, engine):
+        with pytest.raises(RequestTooLargeError):
+            engine.embed(random_images(MAX_BATCH + 1))
+
+
+class TestWarmupAndMetrics:
+    def test_warmup_compiles_every_bucket_once(self):
+        model, variables = tiny_model_and_variables()
+        engine = EmbedEngine(model, variables, max_batch=4, warmup=False)
+        times = engine.warmup()
+        assert set(times) == {1, 2, 4}
+        assert all(t >= 0 for t in times.values())
+        assert engine.warmup() == {}  # idempotent: nothing left to compile
+
+    def test_cache_hit_miss_accounting(self):
+        model, variables = tiny_model_and_variables()
+        metrics = ServeMetrics()
+        engine = EmbedEngine(
+            model, variables, max_batch=4, metrics=metrics, warmup=False
+        )
+        engine.embed(random_images(2))  # cold bucket 2
+        engine.embed(random_images(2))  # warm
+        engine.embed(random_images(3))  # cold bucket 4
+        assert metrics.compile_cache_misses_total.value == 2
+        assert metrics.compile_cache_hits_total.value == 1
+        assert metrics.batches_total.value == 3
+        assert metrics.batch_rows_total.value == 7
+        assert metrics.batch_capacity_total.value == 8
+        assert metrics.fill_ratio() == pytest.approx(7 / 8)
+        assert metrics.batch_latency_ms.count == 3
+
+    def test_warmed_engine_only_hits(self):
+        model, variables = tiny_model_and_variables()
+        metrics = ServeMetrics()
+        engine = EmbedEngine(model, variables, max_batch=4, metrics=metrics)
+        for n in (1, 2, 3, 4):
+            engine.embed(random_images(n))
+        assert metrics.compile_cache_misses_total.value == 0
+        assert metrics.compile_cache_hits_total.value == 4
+
+
+class TestModelSurface:
+    def test_feature_dim_is_encoder_width(self, engine):
+        assert engine.feature_dim == 16  # TinyContrastive hidden
+
+    def test_use_full_encoder_serves_head_output(self):
+        model, variables = tiny_model_and_variables()
+        engine = EmbedEngine(
+            model, variables, max_batch=2, use_full_encoder=True
+        )
+        assert engine.feature_dim == 8  # TinyContrastive d
+        assert engine.embed(random_images(2)).shape == (2, 8)

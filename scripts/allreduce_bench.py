@@ -1,0 +1,244 @@
+"""Gradient all-reduce microbenchmark: exact vs bf16 vs int8 wire formats.
+
+Times ``compress.grad_allreduce`` under ``shard_map`` over the data axis at
+the flagship gradient sizes (ResNet-18 and ResNet-50 contrastive pytrees,
+counted via ``jax.eval_shape`` — no weights materialized) and reports, per
+(model, mode), measured ms/step next to the analytic bytes-on-wire from
+``compress.allreduce_wire_bytes``. ONE JSON payload line:
+
+    {"metric": "allreduce_wire_reduction_int8_vs_exact", "value": 3.98,
+     "unit": "x", "headline_model": "resnet18", "n_devices": ...,
+     "models": {"resnet18": {"n_elements": ...,
+                             "modes": {"exact": {"ms_per_step": ...,
+                                                 "wire_mb_per_device": ...},
+                                       ...}}}, ...}
+
+The headline is the acceptance number: bytes-on-wire reduction of int8 vs
+fp32 at the FIRST model's gradient size (>= 3x required). It is analytic —
+a property of the wire format, not the host — so the payload is meaningful
+even from a CPU run; ms/step carries the measured side and names its
+backend. On a multichip TPU run this is the ``allreduce_bench`` stage of
+``scripts/tpu_watch.sh``.
+
+Robustness contract (same as bench.py / serve_bench.py): never exits
+nonzero, never ends on a traceback, emits EXACTLY ONE payload line; a
+wall-clock budget drops unfinished (model, mode) pairs LOUDLY under
+``"skipped"``, and SIGTERM emits best-so-far.
+
+Env knobs: ``ALLREDUCE_BENCH_SIZES`` (``name=n_elements,...`` — bypasses
+model tracing; the fast tests use a tiny size), ``ALLREDUCE_BENCH_MODES``
+(default ``exact,bf16,int8``), ``ALLREDUCE_BENCH_ITERS`` (default 10),
+``ALLREDUCE_BENCH_BUDGET_S`` (default 600).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_MODES = "exact,bf16,int8"
+DEFAULT_ITERS = 10
+WARMUP_ITERS = 2
+DEFAULT_BUDGET_S = 600.0
+EMIT_RESERVE_S = 5.0
+
+_PAYLOAD_EMITTED = False
+_BEST_SO_FAR: dict | None = None
+
+
+def _emit_payload(payload: dict) -> None:
+    """Print the run's single payload line, exactly once (bench.py contract)."""
+    global _PAYLOAD_EMITTED
+    if _PAYLOAD_EMITTED:
+        return
+    _PAYLOAD_EMITTED = True
+    print(json.dumps(payload), flush=True)
+
+
+def last_ditch_payload(exc: BaseException) -> dict:
+    return {
+        "metric": "allreduce_wire_reduction_int8_vs_exact",
+        "value": 0.0,
+        "unit": "x",
+        "error": repr(exc),
+    }
+
+
+def _sigterm_backstop(signum, frame) -> None:
+    if not _PAYLOAD_EMITTED:
+        _emit_payload(
+            _BEST_SO_FAR
+            if _BEST_SO_FAR is not None
+            else last_ditch_payload(
+                RuntimeError(f"terminated by signal {signum} before finishing")
+            )
+        )
+    os._exit(0)
+
+
+def gradient_sizes() -> dict[str, int]:
+    """{model: flat gradient element count}, traced — no params materialized.
+
+    The gradient pytree the train step all-reduces is exactly the params
+    pytree, so the element count is the param count of the contrastive
+    model (encoder + projection head).
+    """
+    sizes_env = os.environ.get("ALLREDUCE_BENCH_SIZES")
+    if sizes_env:
+        out = {}
+        for item in sizes_env.split(","):
+            name, _, n = item.partition("=")
+            out[name.strip()] = int(n)
+        return out
+
+    import jax
+    import jax.numpy as jnp
+
+    from simclr_tpu.models.contrastive import ContrastiveModel
+
+    out = {}
+    for base_cnn in ("resnet18", "resnet50"):
+        model = ContrastiveModel(base_cnn=base_cnn, d=128)
+        shapes = jax.eval_shape(
+            lambda k, m=model: m.init(
+                k, jnp.zeros((2, 32, 32, 3), jnp.float32), train=False
+            ),
+            jax.random.key(0),
+        )
+        out[base_cnn] = sum(
+            int(l.size) for l in jax.tree.leaves(shapes["params"])
+        )
+    return out
+
+
+def bench_mode(mesh, n_elements: int, mode: str, iters: int) -> float:
+    """Median ms per grad_allreduce step on a flat vector of ``n_elements``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from simclr_tpu.parallel import compress
+    from simclr_tpu.parallel.mesh import DATA_AXIS, shard_map
+
+    def body(x, step):
+        i = jax.lax.axis_index(DATA_AXIS)
+        key = jax.random.fold_in(jax.random.fold_in(jax.random.key(0), step), i)
+        return compress.grad_allreduce(
+            {"g": x}, DATA_AXIS, mode, key=key
+        )["g"]
+
+    fn = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=(P(), P()), out_specs=P())
+    )
+    x = jnp.linspace(-1.0, 1.0, n_elements, dtype=jnp.float32)
+    for step in range(WARMUP_ITERS):
+        fn(x, jnp.int32(step)).block_until_ready()
+    times = []
+    for step in range(iters):
+        t0 = time.perf_counter()
+        fn(x, jnp.int32(WARMUP_ITERS + step)).block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000.0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def assemble_payload(models: dict, extra: dict) -> dict:
+    """Headline: analytic wire reduction int8 vs exact at the first model."""
+    from simclr_tpu.parallel.compress import allreduce_wire_bytes
+
+    headline_model = next(iter(models), None)
+    value = 0.0
+    if headline_model is not None:
+        n = models[headline_model]["n_elements"]
+        n_dev = extra["n_devices"]
+        value = allreduce_wire_bytes(n, n_dev, "exact") / allreduce_wire_bytes(
+            n, n_dev, "int8"
+        )
+    payload = {
+        "metric": "allreduce_wire_reduction_int8_vs_exact",
+        "value": round(value, 3),
+        "unit": "x",
+        "headline_model": headline_model,
+        "models": models,
+    }
+    payload.update(extra)
+    return payload
+
+
+def main() -> None:
+    global _BEST_SO_FAR
+    deadline = time.monotonic() + float(
+        os.environ.get("ALLREDUCE_BENCH_BUDGET_S", DEFAULT_BUDGET_S)
+    )
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_backstop)
+    except ValueError:  # non-main thread (embedded runs)
+        pass
+
+    import jax
+
+    from simclr_tpu.parallel.compress import (
+        DEFAULT_BUCKET_SIZE,
+        allreduce_wire_bytes,
+        validate_mode,
+    )
+    from simclr_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    modes = [
+        validate_mode(m.strip())
+        for m in os.environ.get("ALLREDUCE_BENCH_MODES", DEFAULT_MODES).split(",")
+        if m.strip()
+    ]
+    iters = int(os.environ.get("ALLREDUCE_BENCH_ITERS", DEFAULT_ITERS))
+    mesh = create_mesh(MeshSpec(data=-1, model=1))
+    n_dev = len(jax.devices())
+    extra = {
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+        "bucket_size": DEFAULT_BUCKET_SIZE,
+        "iters": iters,
+    }
+
+    sizes = gradient_sizes()
+    models: dict[str, dict] = {}
+    skipped: list[str] = []
+    for name, n_elements in sizes.items():
+        entry = {"n_elements": n_elements, "modes": {}}
+        for mode in modes:
+            # budget discipline: drop unfinished pairs loudly, not silently
+            if time.monotonic() > deadline - EMIT_RESERVE_S:
+                skipped.append(f"{name}/{mode}")
+                continue
+            ms = bench_mode(mesh, n_elements, mode, iters)
+            entry["modes"][mode] = {
+                "ms_per_step": round(ms, 3),
+                "wire_mb_per_device": round(
+                    allreduce_wire_bytes(n_elements, n_dev, mode) / 2**20, 3
+                ),
+            }
+            print(f"# {name}/{mode}: {ms:.3f} ms/step", file=sys.stderr)
+        if entry["modes"]:
+            models[name] = entry
+        else:
+            skipped.append(name)
+        _BEST_SO_FAR = assemble_payload(models, extra)
+
+    payload = assemble_payload(models, extra)
+    if skipped:
+        payload["skipped"] = skipped
+        print(f"# budget exhausted; skipped {skipped}", file=sys.stderr)
+    _emit_payload(payload)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as exc:  # last-ditch contract keeper: one line, rc 0
+        print(f"# unexpected error: {exc!r}", file=sys.stderr)
+        _emit_payload(last_ditch_payload(exc))
+    sys.exit(0)

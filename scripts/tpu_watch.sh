@@ -47,8 +47,10 @@ MAX_STAGE_FAILS=3
 # matrix leads, then MFU attribution, then the on-device learning smoke
 # (training + eval_every monitor on the real chip), then a bench refresh
 # (keeps the committed capture young, see bench.py provenance decay),
-# then the remaining step matrices.
-STAGES="loss_variants attrib512 train_smoke bench remat2048 explore1024 explore512"
+# then the collective wire-format microbench (zero on-chip numbers yet —
+# PERF.md's compressed-collectives rows are pending on it), then the
+# remaining step matrices.
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -135,7 +137,7 @@ stage_timeout() { echo "${TPU_WATCH_STAGE_TIMEOUT:-$1}"; }
 # the committed capture after its own probe fails, so only a fresher
 # capture file counts.
 run_stage() {
-    local name="$1" rc before after
+    local name="$1" rc before after out
     if [ "$(date +%s)" -ge "$DEADLINE" ]; then
         return 1
     fi
@@ -176,6 +178,22 @@ run_stage() {
             run_locked "$(stage_timeout 1200)" python scripts/perf_explore.py \
                 --steps 50 --batch 1024 >> "$LOG" 2>&1
             rc=$? ;;
+        allreduce_bench)
+            # grad all-reduce wire-format microbench (exact/bf16/int8,
+            # scripts/allreduce_bench.py). The script exits 0 even on
+            # error (bench.py robustness contract), so rc alone proves
+            # nothing: only an error-free payload line counts as
+            # collected evidence.
+            out="$STATE/allreduce_bench.out"
+            run_locked "$(stage_timeout 900)" python scripts/allreduce_bench.py \
+                > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"metric": "allreduce_wire_reduction' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
         bench)
             # bench.py takes the chip lock itself (BENCH_LOCK_WAIT_S
             # bounded below the outer timeout so contention can't look

@@ -441,6 +441,19 @@ def _check_runtime_conf(cfg: Config) -> None:
         in ("replicated", "sharded"),
         "runtime.dataset_residency must be 'replicated' or 'sharded'",
     )
+    _check_parallel_conf(cfg)
+
+
+def _check_parallel_conf(cfg: Config) -> None:
+    # single source of truth for the valid set: parallel/compress.py
+    from simclr_tpu.parallel.compress import GRAD_ALLREDUCE_MODES
+
+    mode = cfg.select("parallel.grad_allreduce", "exact")
+    _require(
+        mode in GRAD_ALLREDUCE_MODES,
+        f"parallel.grad_allreduce must be one of {GRAD_ALLREDUCE_MODES}, "
+        f"got {mode!r}",
+    )
 
 
 def check_eval_conf(cfg: Config) -> None:

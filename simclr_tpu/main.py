@@ -167,6 +167,10 @@ def run_pretrain(cfg: Config) -> dict:
         fused=bool(cfg.select("loss.fused", False)),
         forward_mode=str(cfg.select("model.forward_mode", "two_pass")),
         remat=bool(cfg.select("model.remat", False)),
+        # parallel.grad_allreduce: wire format of the data-axis gradient
+        # all-reduce — exact | bf16 | int8 (parallel/compress.py,
+        # docs/PERF.md §"Compressed collectives")
+        grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
     )
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     # runtime.dataset_residency: "replicated" keeps the whole dataset in every
@@ -209,6 +213,7 @@ def run_pretrain(cfg: Config) -> dict:
                 strength=step_kwargs["strength"],
                 remat=step_kwargs["remat"],
                 residency=residency,
+                grad_allreduce=step_kwargs["grad_allreduce"],
             )
             images_all = put_dataset(dataset.images, mesh)
             iterator = None
@@ -218,6 +223,7 @@ def run_pretrain(cfg: Config) -> dict:
                 temperature=step_kwargs["temperature"],
                 strength=step_kwargs["strength"],
                 remat=step_kwargs["remat"],
+                grad_allreduce=step_kwargs["grad_allreduce"],
             )
             iterator = EpochIterator(
                 dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,

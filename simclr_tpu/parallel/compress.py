@@ -1,0 +1,221 @@
+"""Compressed gradient all-reduce over a mesh axis (``parallel.grad_allreduce``).
+
+SimCLR's large-batch recipe is communication-bound the moment it leaves one
+chip: every step all-reduces the full fp32 gradient pytree, and at multihost
+scale that crosses DCN where bandwidth — not the MXU — sets the step floor
+(EQuARX, PAPERS.md). This module is the drop-in replacement for the
+``jax.lax.psum(grads, DATA_AXIS)`` sites in ``parallel/steps.py`` and
+``parallel/tp.py``, selected by the ``parallel.grad_allreduce`` knob:
+
+  * ``exact`` — the plain fp32 ``psum`` (default; bitwise-identical to the
+    pre-knob behavior).
+  * ``bf16``  — cast → ``psum`` → cast back. Halves wire bytes; the mantissa
+    truncation is deterministic (biased toward zero) but tiny relative to
+    LARS' trust-ratio normalization.
+  * ``int8``  — bucketed stochastic-rounding quantization, ~3.98x fewer wire
+    bytes than fp32 at the default bucket size (see
+    :func:`allreduce_wire_bytes`). Unbiased: E[dequant(quant(x))] = x.
+
+The int8 reduction keeps the WIRE format int8 end to end by decomposing the
+all-reduce the way a ring all-reduce does — a reduce-scatter phase and an
+all-gather phase — with the summation lifted out of the network:
+
+  1. flatten the pytree to one fp32 vector, pad, and cut into fixed-size
+     buckets; quantize each bucket as ``q = floor(x / scale + u)`` with
+     ``scale = amax(|bucket|) / 127`` and ``u ~ Uniform[0, 1)`` drawn from
+     the per-step PRNG key (stochastic rounding — the estimator is unbiased
+     and, because the key is threaded from the train step, reproducible);
+  2. *scatter*: ``all_to_all`` the int8 buckets (plus the tiny fp32 scale
+     vector) so each device receives every peer's copy of the bucket range
+     it owns — this is ``psum_scatter`` with the sum deferred, because int8
+     partial sums would overflow and carry no shared scale;
+  3. *local dequant-accumulate*: each device sums its owned range in fp32;
+  4. *gather*: requantize the reduced range (fresh stochastic rounding, a
+     folded key) and ``all_gather`` it back as int8; every device
+     dequantizes and unflattens into the original pytree structure.
+
+Both phases ship int8 payloads; the only fp32 on the wire is one scale per
+``bucket_size`` elements (1/256 overhead at the default 1024).
+
+Ordering contract (L2): compression replaces the gradient ``psum`` and
+therefore runs BEFORE the optimizer — quantize-before-LARS, never after.
+LARS (``ops/lars.py``) rescales each layer by ``||p|| / ||g||``; feeding it
+the identical dequantized gradient on every replica keeps the trust ratios
+replica-identical, whereas quantizing the *update* after the trust ratio
+would break that and compound the error through the momentum buffer.
+
+TP note: compression applies to the DATA axis only. Model-axis collectives
+(the activation gathers/reduce-scatters inside ``models/heads.py`` and the
+head-gradient psums) stay exact — they carry activations, not gradients,
+and sit on fast ICI, not DCN. ``tp.py`` folds its PRNG key with the data
+axis index only, so model-axis replicas draw identical rounding noise and
+replicated-parameter gradients stay bitwise identical across the model axis
+after dequantization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from simclr_tpu.parallel.mesh import axis_size
+
+GRAD_ALLREDUCE_MODES = ("exact", "bf16", "int8")
+
+# elements per quantization bucket: one fp32 scale per bucket is the wire
+# overhead (4/1024 -> 0.4%), while smaller buckets track the gradient's
+# dynamic range more tightly. 1024 matches EQuARX's block size ballpark.
+DEFAULT_BUCKET_SIZE = 1024
+
+# fold_in tag forking the quantization PRNG stream off the train step's
+# per-step rng: the augmentation stream splits the same rng, so the tag
+# keeps the two streams disjoint (steps.py / tp.py use this constant)
+KEY_FOLD_QUANT = 0x71
+
+# int8 symmetric range [-127, 127]; -128 is left unused so the scale is the
+# same magnitude in both directions
+_QMAX = 127.0
+
+
+def validate_mode(mode: str) -> str:
+    """Reject unknown modes with the valid set spelled out (config + runtime)."""
+    if mode not in GRAD_ALLREDUCE_MODES:
+        raise ValueError(
+            f"parallel.grad_allreduce must be one of {GRAD_ALLREDUCE_MODES}, "
+            f"got {mode!r}"
+        )
+    return mode
+
+
+def allreduce_wire_bytes(
+    n_elements: int,
+    n_devices: int,
+    mode: str,
+    *,
+    bucket_size: int = DEFAULT_BUCKET_SIZE,
+) -> float:
+    """Analytic per-device wire bytes for one gradient all-reduce.
+
+    Bandwidth-optimal all-reduce moves ``2 * (n-1)/n * payload`` bytes
+    through each device (reduce-scatter + all-gather, each ``(n-1)/n``);
+    the mode sets the payload encoding:
+
+      * exact: 4 bytes/element (fp32)
+      * bf16:  2 bytes/element
+      * int8:  1 byte/element + one fp32 scale per bucket (padding included,
+        matching what :func:`grad_allreduce` actually ships)
+
+    At the default bucket size int8 is ``4 / (1 + 4/1024)`` ≈ 3.98x smaller
+    than exact — the microbenchmark (``scripts/allreduce_bench.py``) reports
+    this next to measured ms/step.
+    """
+    validate_mode(mode)
+    n = max(int(n_devices), 1)
+    phase_fraction = 2.0 * (n - 1) / n
+    if mode == "exact":
+        payload = 4.0 * n_elements
+    elif mode == "bf16":
+        payload = 2.0 * n_elements
+    else:
+        n_buckets = -(-int(n_elements) // bucket_size)  # ceil
+        n_buckets = -(-n_buckets // n) * n  # pad bucket count to axis size
+        payload = float(n_buckets * bucket_size) + 4.0 * n_buckets
+    return phase_fraction * payload
+
+
+def _quantize(x: jnp.ndarray, key: jax.Array) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Stochastic-rounding int8 quantization of (buckets, bucket_size) fp32.
+
+    ``q = floor(x / scale + u)``, ``u ~ U[0, 1)``: E[q * scale] = x exactly,
+    for any x — the rounding error is zero-mean noise, not bias. All-zero
+    buckets (padding, dead layers) get scale 0 and quantize to 0.
+    """
+    amax = jnp.max(jnp.abs(x), axis=1)
+    scale = amax / _QMAX
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    u = jax.random.uniform(key, x.shape, dtype=x.dtype)
+    q = jnp.clip(jnp.floor(x / safe[:, None] + u), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def _int8_allreduce(
+    flat: jnp.ndarray, axis_name: str, key: jax.Array, bucket_size: int
+) -> jnp.ndarray:
+    """Sum ``flat`` (fp32 vector) over ``axis_name`` with int8 wire format.
+
+    Returns the fp32 vector of the same length; see the module docstring for
+    the scatter / local-accumulate / gather decomposition.
+    """
+    n = axis_size(axis_name)
+    n_elements = flat.shape[0]
+    n_buckets = -(-n_elements // bucket_size)
+    n_buckets = -(-n_buckets // n) * n
+    padded = n_buckets * bucket_size
+    x = jnp.zeros((padded,), flat.dtype).at[:n_elements].set(flat)
+    x = x.reshape(n_buckets, bucket_size)
+
+    q, scale = _quantize(x, key)
+
+    # scatter: device d ends up holding every peer's quantized copy of
+    # bucket range [d*chunk, (d+1)*chunk) — int8 on the wire, scales are the
+    # only fp32 (one per bucket)
+    chunk = n_buckets // n
+    q_all = jax.lax.all_to_all(
+        q.reshape(n, chunk, bucket_size), axis_name, split_axis=0, concat_axis=0
+    )
+    s_all = jax.lax.all_to_all(
+        scale.reshape(n, chunk), axis_name, split_axis=0, concat_axis=0
+    )
+
+    # local dequant-accumulate: the deferred sum of the reduce-scatter
+    reduced = jnp.sum(
+        q_all.astype(flat.dtype) * s_all[:, :, None], axis=0
+    )
+
+    # gather: requantize the reduced chunk (fresh rounding noise from a
+    # folded key) and all_gather it back as int8
+    q2, s2 = _quantize(reduced, jax.random.fold_in(key, 1))
+    q2_all = jax.lax.all_gather(q2, axis_name, axis=0, tiled=True)
+    s2_all = jax.lax.all_gather(s2, axis_name, axis=0, tiled=True)
+
+    out = q2_all.astype(flat.dtype) * s2_all[:, None]
+    return out.reshape(-1)[:n_elements]
+
+
+def grad_allreduce(
+    grads,
+    axis_name: str,
+    mode: str = "exact",
+    *,
+    key: jax.Array | None = None,
+    bucket_size: int = DEFAULT_BUCKET_SIZE,
+):
+    """All-reduce (sum) a gradient pytree over ``axis_name``.
+
+    Drop-in for ``jax.lax.psum(grads, axis_name)`` inside ``shard_map``.
+    ``mode`` selects the wire format (:data:`GRAD_ALLREDUCE_MODES`); ``int8``
+    requires ``key`` — the per-step PRNG key that makes the stochastic
+    rounding unbiased AND reproducible (thread it from the train step's rng;
+    under TP, fold with the data-axis index only so model-axis replicas
+    round identically). Leaf dtypes and the pytree structure are preserved.
+    """
+    validate_mode(mode)
+    if mode == "exact":
+        return jax.lax.psum(grads, axis_name)
+    if mode == "bf16":
+        return jax.tree.map(
+            lambda g: jax.lax.psum(g.astype(jnp.bfloat16), axis_name).astype(g.dtype),
+            grads,
+        )
+    if key is None:
+        raise ValueError("grad_allreduce mode 'int8' requires a PRNG key")
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads
+    flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves])
+    summed = _int8_allreduce(flat, axis_name, key, bucket_size)
+    out, offset = [], 0
+    for l in leaves:
+        out.append(summed[offset:offset + l.size].reshape(l.shape).astype(l.dtype))
+        offset += l.size
+    return jax.tree.unflatten(treedef, out)

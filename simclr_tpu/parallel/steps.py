@@ -49,6 +49,7 @@ from simclr_tpu.ops.ntxent_pallas import (
     ntxent_loss_fused_sharded,
 )
 from simclr_tpu.ops.ntxent_ring import ntxent_loss_ring
+from simclr_tpu.parallel import compress
 from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, axis_size, shard_map
 from simclr_tpu.parallel.train_state import TrainState
 
@@ -228,11 +229,20 @@ def _make_local_pretrain_step(
     forward_mode: str,
     remat: bool,
     out_size: int,
+    grad_allreduce: str = "exact",
 ):
     """The per-replica contrastive step, shared verbatim by the
     dispatch-per-step (:func:`make_pretrain_step`) and epoch-compiled
     (:func:`make_pretrain_epoch_fn`) paths so their numerics can never
-    diverge."""
+    diverge.
+
+    ``grad_allreduce`` selects the gradient all-reduce wire format
+    (``parallel/compress.py``): ``exact`` is the plain fp32 psum; ``bf16``
+    and ``int8`` compress the data-axis collective. Compression happens
+    BEFORE ``tx.update`` — quantize-before-LARS — so every replica feeds the
+    optimizer the identical dequantized gradient.
+    """
+    compress.validate_mode(grad_allreduce)
     if negatives not in ("global", "local", "ring"):
         raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
     if forward_mode not in ("two_pass", "concat"):
@@ -268,7 +278,12 @@ def _make_local_pretrain_step(
             return loss, new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        grads = jax.lax.psum(grads, DATA_AXIS)
+        # the quantization stream forks off the same per-step, per-data-shard
+        # rng the augmentations use (fold_in is the jax stream-split idiom)
+        grads = compress.grad_allreduce(
+            grads, DATA_AXIS, grad_allreduce,
+            key=jax.random.fold_in(rng, compress.KEY_FOLD_QUANT),
+        )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -292,6 +307,7 @@ def make_pretrain_step(
     forward_mode: str = "two_pass",
     remat: bool = False,
     out_size: int = 32,
+    grad_allreduce: str = "exact",
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
     """Build the jitted contrastive train step.
 
@@ -310,6 +326,7 @@ def make_pretrain_step(
         model, tx,
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
+        grad_allreduce=grad_allreduce,
     )
     sharded = shard_map(
         local_step,
@@ -334,6 +351,7 @@ def make_pretrain_epoch_fn(
     remat: bool = False,
     out_size: int = 32,
     residency: str = "replicated",
+    grad_allreduce: str = "exact",
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled training: one XLA program per EPOCH, zero host work
     per step.
@@ -370,6 +388,7 @@ def make_pretrain_epoch_fn(
         model, tx,
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
+        grad_allreduce=grad_allreduce,
     )
     return _make_epoch_fn(per_step, mesh, n_arrays=1, residency=residency)
 
@@ -462,9 +481,12 @@ def _make_epoch_fn(per_step, mesh, *, n_arrays: int, residency: str = "replicate
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def _make_local_supervised_step(model, tx, *, strength: float, out_size: int):
+def _make_local_supervised_step(
+    model, tx, *, strength: float, out_size: int, grad_allreduce: str = "exact"
+):
     """Per-replica supervised CE step, shared by the dispatch-per-step and
     epoch-compiled paths (see :func:`_make_local_pretrain_step`)."""
+    compress.validate_mode(grad_allreduce)
 
     def local_step(state: TrainState, images, labels, rng):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
@@ -487,7 +509,10 @@ def _make_local_supervised_step(model, tx, *, strength: float, out_size: int):
         (loss, (new_stats, correct, n_local)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
-        grads = jax.lax.psum(grads, DATA_AXIS)
+        grads = compress.grad_allreduce(
+            grads, DATA_AXIS, grad_allreduce,
+            key=jax.random.fold_in(rng, compress.KEY_FOLD_QUANT),
+        )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -508,6 +533,7 @@ def make_supervised_step(
     *,
     strength: float = 0.5,
     out_size: int = 32,
+    grad_allreduce: str = "exact",
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Jitted supervised CE train step (one SimCLR-augmented view).
 
@@ -516,7 +542,8 @@ def make_supervised_step(
     ``create_simclr_data_augmentation``) with CE loss (``supervised.py:104``).
     """
     local_step = _make_local_supervised_step(
-        model, tx, strength=strength, out_size=out_size
+        model, tx, strength=strength, out_size=out_size,
+        grad_allreduce=grad_allreduce,
     )
     sharded = shard_map(
         local_step,
@@ -536,6 +563,7 @@ def make_supervised_epoch_fn(
     strength: float = 0.5,
     out_size: int = 32,
     residency: str = "replicated",
+    grad_allreduce: str = "exact",
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled supervised training (see
     :func:`make_pretrain_epoch_fn` — same design: dataset resident on
@@ -546,7 +574,8 @@ def make_supervised_epoch_fn(
     base_key, step0) -> (state, {"loss": (steps,), "accuracy": (steps,)})``.
     """
     per_step = _make_local_supervised_step(
-        model, tx, strength=strength, out_size=out_size
+        model, tx, strength=strength, out_size=out_size,
+        grad_allreduce=grad_allreduce,
     )
     return _make_epoch_fn(per_step, mesh, n_arrays=2, residency=residency)
 

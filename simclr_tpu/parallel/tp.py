@@ -48,6 +48,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from simclr_tpu.models.resnet import feature_dim
 from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
+from simclr_tpu.parallel import compress
 from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, axis_size, shard_map
 from simclr_tpu.parallel.steps import (
     RESIDENCIES,
@@ -128,12 +129,20 @@ def _make_step_body(
     strength: float,
     out_size: int,
     remat: bool = False,
+    grad_allreduce: str = "exact",
 ):
     """The un-jitted TP step: shard_map'ed forward/backward + jit-level
     optimizer update. Shared by the dispatch-per-step and epoch-compiled
     paths so their numerics can never diverge (same pattern as
     ``steps._make_local_pretrain_step``). ``remat`` rematerializes the
-    forward during backward exactly like ``steps._forward_fn``."""
+    forward during backward exactly like ``steps._forward_fn``.
+
+    ``grad_allreduce`` compresses the DATA-axis gradient all-reduce only
+    (``parallel/compress.py``); the head's model-axis f/g collectives stay
+    exact. The quantization key is forked from the data-index-folded rng, so
+    model-axis replicas draw identical rounding noise and replicated
+    (encoder) gradients stay identical across the model axis."""
+    compress.validate_mode(grad_allreduce)
     tp = mesh.shape[MODEL_AXIS]
     local_model = _local_view(model, tp)
     fwd = _forward_fn(local_model, remat)  # the dp step's forward/remat recipe
@@ -151,7 +160,12 @@ def _make_step_body(
             return loss, mut["batch_stats"]
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        grads = jax.lax.psum(grads, DATA_AXIS)  # same convention as steps.py
+        # same convention as steps.py: sum over the data axis (compressed
+        # per grad_allreduce), BEFORE the jit-level LARS update below
+        grads = compress.grad_allreduce(
+            grads, DATA_AXIS, grad_allreduce,
+            key=jax.random.fold_in(rng, compress.KEY_FOLD_QUANT),
+        )
         # No model-axis correction here: the head's f/g boundary operators
         # (models/heads.py) own the model-axis collectives in both forward
         # and backward, so encoder grads arrive complete and replica-
@@ -193,6 +207,7 @@ def make_pretrain_step_tp(
     strength: float = 0.5,
     out_size: int = 32,
     remat: bool = False,
+    grad_allreduce: str = "exact",
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Contrastive train step with the projection head tensor-parallel over
     the ``model`` mesh axis (global NT-Xent negatives over ``data``).
@@ -205,7 +220,7 @@ def make_pretrain_step_tp(
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
-        remat=remat,
+        remat=remat, grad_allreduce=grad_allreduce,
     )
     return jax.jit(step, donate_argnums=(0,))
 
@@ -220,6 +235,7 @@ def make_pretrain_epoch_fn_tp(
     out_size: int = 32,
     remat: bool = False,
     residency: str = "replicated",
+    grad_allreduce: str = "exact",
 ) -> Callable[..., tuple[TrainState, dict]]:
     """Epoch-compiled TP training: ``lax.scan`` over steps at the JIT level.
 
@@ -247,7 +263,7 @@ def make_pretrain_epoch_fn_tp(
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
-        remat=remat,
+        remat=remat, grad_allreduce=grad_allreduce,
     )
     batched = NamedSharding(mesh, P(DATA_AXIS))
 

@@ -155,6 +155,7 @@ def run_supervised(cfg: Config) -> dict:
         epoch_fn = make_supervised_epoch_fn(
             model, tx, mesh, strength=float(cfg.experiment.strength),
             residency=residency,
+            grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
         )
         put_dataset = put_replicated if residency == "replicated" else put_row_sharded
         images_all = put_dataset(train_ds.images, mesh)
@@ -162,7 +163,8 @@ def run_supervised(cfg: Config) -> dict:
         train_iter = None
     else:
         train_step = make_supervised_step(
-            model, tx, mesh, strength=float(cfg.experiment.strength)
+            model, tx, mesh, strength=float(cfg.experiment.strength),
+            grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
         )
         train_iter = EpochIterator(
             train_ds, global_batch, seed=seed, shuffle=True, sharding=data_shard,

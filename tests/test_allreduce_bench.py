@@ -1,0 +1,76 @@
+"""scripts/allreduce_bench.py contract (the compressed-collectives microbench).
+
+Subprocess runs with ``ALLREDUCE_BENCH_SIZES`` pinning a tiny gradient so the
+8-virtual-device CPU mesh finishes fast; assertions pin the one-payload-line
+robustness contract (bench.py family) and the per-(model, mode) report shape.
+The >=3x wire-reduction acceptance number at the REAL ResNet-18 gradient
+size is pinned analytically in tests/test_compress.py (the ratio is a
+property of the wire format, not the host), so these tests only need the
+script to compute and report it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "scripts", "allreduce_bench.py")
+
+
+def _run(extra_env=None, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, BENCH],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def _payload_lines(stdout):
+    return [l for l in stdout.splitlines() if l.strip().startswith("{")]
+
+
+def test_reports_all_modes_with_wire_bytes_and_timings():
+    r = _run({"ALLREDUCE_BENCH_SIZES": "tiny=65536", "ALLREDUCE_BENCH_ITERS": "1"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _payload_lines(r.stdout)
+    assert len(lines) == 1, r.stdout  # exactly one payload line
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "allreduce_wire_reduction_int8_vs_exact"
+    assert payload["headline_model"] == "tiny"
+    assert payload["n_devices"] == 8
+    modes = payload["models"]["tiny"]["modes"]
+    assert set(modes) == {"exact", "bf16", "int8"}
+    for mode, entry in modes.items():
+        assert entry["ms_per_step"] > 0.0, mode
+        assert entry["wire_mb_per_device"] > 0.0, mode
+    # wire-byte ordering is mode-monotone at any size
+    assert (
+        modes["exact"]["wire_mb_per_device"]
+        > modes["bf16"]["wire_mb_per_device"]
+        > modes["int8"]["wire_mb_per_device"]
+    )
+    # headline ratio matches the analytic wire-bytes quotient it claims
+    from simclr_tpu.parallel.compress import allreduce_wire_bytes
+
+    want = allreduce_wire_bytes(65536, 8, "exact") / allreduce_wire_bytes(
+        65536, 8, "int8"
+    )
+    assert abs(payload["value"] - want) < 0.01
+
+
+def test_exhausted_budget_skips_loudly_and_still_emits():
+    r = _run({
+        "ALLREDUCE_BENCH_SIZES": "tiny=4096",
+        "ALLREDUCE_BENCH_BUDGET_S": "0",
+    })
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _payload_lines(r.stdout)
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "allreduce_wire_reduction_int8_vs_exact"
+    assert payload["skipped"], payload  # dropped pairs recorded, not silent
+    assert payload["models"] == {}

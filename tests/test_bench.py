@@ -317,6 +317,84 @@ def test_sigterm_backstop_emits_payload(tmp_path):
     assert "terminated by signal" in parsed.get("error", "")
 
 
+def test_sigkill_mid_probe_leaves_provisional_payload(tmp_path):
+    """SIGKILL insurance (emit_provisional): ``timeout -s KILL`` firing while
+    the probe is still running — no handler can run — must still leave a
+    valid parsed payload on stdout: the committed capture, emitted as a
+    ``provisional: true`` line before the first probe attempt."""
+    import time as _time
+
+    import bench
+
+    cap = tmp_path / "capture.json"
+    cap.write_text(json.dumps({
+        "captured_at": _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
+        "payload": {
+            "metric": "pretrain_imgs_per_sec_per_chip", "value": 2048.0,
+            "unit": "imgs/sec/chip", "backend": "tpu",
+            "per_device_batch": 512, "variant": "two_pass",
+            "variant_rates": {"two_pass": 2048.0},
+        },
+    }))
+    # probing "stubbed slow": a sitecustomize that sleeps only in `python -c`
+    # children (the probe subprocess) — the orchestrator itself stays fast
+    site = tmp_path / "site"
+    site.mkdir()
+    (site / "sitecustomize.py").write_text(
+        "import sys\n"
+        "if sys.argv and sys.argv[0] == '-c':\n"
+        "    import time\n"
+        "    time.sleep(120)\n"
+    )
+    env = dict(os.environ)
+    env["BENCH_CAPTURE_PATH"] = str(cap)
+    env["TPU_WATCH_LOCK"] = str(tmp_path / "chip.lock")
+    env["BENCH_LOCK_WAIT_S"] = "0"
+    env["BENCH_PROBE_BUDGET_S"] = "600"
+    env["BENCH_PROBE_INTERVAL_S"] = "600"
+    env["BENCH_TOTAL_BUDGET_S"] = "600"
+    env["PYTHONPATH"] = str(site) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        ["timeout", "-s", "KILL", "10", sys.executable, BENCH],
+        capture_output=True, text=True, env=env, cwd=REPO,
+    )
+    # 137 = 128+KILL from GNU timeout; -9 when timeout KILLs its own process
+    # group and dies with the child. Either way: killed, not completed.
+    assert r.returncode in (137, -9), (r.returncode, r.stderr[-500:])
+    parsed = bench.parse_last_measurement(r.stdout)
+    assert parsed is not None, f"parsed=null after SIGKILL:\n{r.stdout[-1000:]}"
+    assert parsed["provisional"] is True
+    assert parsed["metric"] == "pretrain_imgs_per_sec_per_chip"
+    assert parsed["value"] == 2048.0
+    assert parsed["baseline_kind"] == "analytic_v100_fp32_ceiling"
+
+
+def test_provisional_line_is_superseded_by_the_real_payload(monkeypatch, capsys):
+    """A run that completes prints its real payload AFTER the provisional
+    line, and the production parser takes the LAST valid line — so the
+    provisional value never shadows an actual measurement."""
+    import bench
+
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET_S", "30")
+    monkeypatch.setenv("BENCH_LOCK_WAIT_S", "0")
+    monkeypatch.setattr(bench, "probe_tpu", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "_run_measurement",
+        lambda backend, timeout_s: {
+            "metric": "pretrain_imgs_per_sec_per_chip", "value": 7.0,
+            "unit": "imgs/sec/chip", "backend": "tpu",
+        },
+    )
+    monkeypatch.setattr(bench, "persist_tpu_capture", lambda payload: None)
+    bench.main()
+    out = capsys.readouterr().out
+    lines = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    assert lines[0].get("provisional") is True
+    parsed = bench.parse_last_measurement(out)
+    assert parsed["value"] == 7.0
+    assert "provisional" not in parsed
+
+
 def test_timeout_salvages_pre_hang_measurement(monkeypatch):
     """A variant that hangs after an earlier variant succeeded must not lose
     the earlier measurement: the worker prints best-so-far after every

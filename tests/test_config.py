@@ -80,6 +80,25 @@ def test_validation_rejects_bad_values():
         check_eval_conf(ev)
 
 
+def test_validation_rejects_unknown_grad_allreduce():
+    """Both entry points' check_*_conf reject an unknown wire format, and
+    the message names the valid set (the operator's fix is in the error)."""
+    from simclr_tpu.config import check_supervised_conf
+
+    cfg = load_config("config")
+    assert cfg.parallel.grad_allreduce == "exact"
+    cfg.parallel.grad_allreduce = "int8"
+    check_pretrain_conf(cfg)  # every shipped mode passes
+    cfg.parallel.grad_allreduce = "fp4"
+    with pytest.raises(ConfigError, match="exact.*bf16.*int8"):
+        check_pretrain_conf(cfg)
+
+    sup = load_config("supervised_config")
+    sup.parallel.grad_allreduce = "fp4"
+    with pytest.raises(ConfigError, match="exact.*bf16.*int8"):
+        check_supervised_conf(sup)
+
+
 def test_serve_config_defaults_and_validation():
     cfg = load_config("serve")
     assert cfg.serve.max_batch == 256

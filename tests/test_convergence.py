@@ -22,6 +22,10 @@ of the same recipe at a longer horizon lives under ``docs/convergence_r5/``
 (see PARITY.md §Learning convergence).
 """
 
+import json
+import os
+
+import numpy as np
 import pytest
 
 from simclr_tpu.main import main as pretrain_main
@@ -86,6 +90,62 @@ def test_pretrain_recipe_learns(tmp_path):
     assert losses[-1] < losses[0] - 0.04, f"loss did not fall: {losses}"
     assert min(losses) < 4.84, f"loss never left the uniform plateau: {losses}"
     assert all(l > 0 for l in losses)
+
+
+def test_pretrain_learns_at_default_batch_512(tmp_path):
+    """The recipe learns AT ITS OWN BATCH SIZE (VERDICT r5: every prior
+    convergence gate ran global batch 64 — the default batch-512 recipe had
+    never been shown to learn). Global batch 512 via 64/device x 8 devices,
+    sigma-40 prototype data, against the COMMITTED random-init control
+    (docs/convergence_r5/random_init_controls.json).
+
+    Calibration (measured 2026-08-05, this mesh): the epoch-0 anchor reads
+    exactly the committed control (0.1006); after ONE epoch (2 steps of
+    batch 512) the centroid probe jumps to 0.72 and stays >= 0.66 through
+    epoch 6. The assertions take half that measured margin. The NT-Xent
+    loss is no gate here: at 2 steps/epoch it hovers at its uniform plateau
+    (ln(1023) ~= 6.93, measured 6.99 -> 6.95 over 3 epochs), so only
+    sanity is pinned — the centroid monitor vs the control is the evidence,
+    exactly as documented for this data family (see controls json note).
+    """
+    summary = pretrain_main(
+        [
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=1024",
+            "experiment.synthetic_noise=40",
+            "experiment.batches=64",  # x8 devices -> the recipe's batch 512
+            "precision.compute_dtype=float32",  # CPU-mesh run; TPU uses bf16
+            "parameter.epochs=3",
+            "parameter.warmup_epochs=1",
+            "experiment.eval_every=1",
+            "experiment.save_model_epoch=1000",
+            f"experiment.save_dir={tmp_path / 'b512'}",
+        ]
+    )
+    controls_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "convergence_r5", "random_init_controls.json",
+    )
+    with open(controls_path) as f:
+        control = json.load(f)["random_init_centroid_val_top1"]["sigma40"]
+
+    monitor = {int(e): a for e, a in summary["monitor_history"]}
+    assert abs(monitor[0] - control) < 0.05, (
+        f"random-init anchor drifted from the committed control {control}: "
+        f"{monitor}"
+    )
+    peak = max(a for e, a in monitor.items() if e >= 1)
+    assert peak >= control + 0.2, (
+        f"batch-512 recipe never beat the random-init control {control}: "
+        f"{monitor}"
+    )
+    assert peak >= 3 * CHANCE, f"no learning signal at batch 512: {monitor}"
+
+    losses = [loss for _, loss in summary["loss_history"]]
+    assert all(np.isfinite(l) and l > 0 for l in losses), losses
+    # global batch 512 -> 1023 candidates; at 6 total steps the objective
+    # stays near ln(1023) ~= 6.93 — sanity only, see docstring
+    assert max(losses) < 7.5, losses
 
 
 def test_supervised_baseline_learns(tmp_path):

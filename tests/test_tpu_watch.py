@@ -20,7 +20,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
-    "remat2048", "explore1024", "explore512",
+    "allreduce_bench", "remat2048", "explore1024", "explore512",
 )
 
 
@@ -62,6 +62,13 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
     for name in fail_scripts:
         lines += [f'case "$*" in *{name}*) exit 1;; esac']
     lines += [
+        # the allreduce_bench stage greps its stdout for an error-free
+        # payload line (its script exits 0 even on error); note the
+        # *bench.py* case below also substring-matches this invocation,
+        # harmlessly re-touching the capture
+        'case "$*" in *allreduce_bench.py*) '
+        'echo \'{"metric": "allreduce_wire_reduction_int8_vs_exact", '
+        '"value": 3.98, "unit": "x"}\';; esac',
         # sleep first: the stage's freshness check compares whole-second
         # mtimes, and consecutive tests touch the same file
         'case "$*" in *bench.py*) sleep 1; touch "$BENCH_CAPTURE_PATH";; esac',
@@ -148,6 +155,19 @@ def test_bench_marker_requires_fresh_capture(tmp_path):
     assert "bench" not in _done(state)
     assert (state / "bench.fails").exists()
     assert "stage bench FAILED" in log.read_text()
+
+
+def test_allreduce_marker_requires_error_free_payload(tmp_path):
+    """allreduce_bench.py exiting 0 with an error payload (its last-ditch
+    contract keeper) must not earn allreduce_bench.done."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"value": 3.98, "unit": "x"}', '"value": 0.0, "error": "boom"}'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "allreduce_bench" not in _done(state)
+    assert (state / "allreduce_bench.fails").exists()
+    assert "stage allreduce_bench FAILED" in log.read_text()
 
 
 def test_repeat_offender_is_deferred_not_skipped(tmp_path):

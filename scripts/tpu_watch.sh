@@ -49,8 +49,9 @@ MAX_STAGE_FAILS=3
 # (keeps the committed capture young, see bench.py provenance decay),
 # then the collective wire-format microbench (zero on-chip numbers yet —
 # PERF.md's compressed-collectives rows are pending on it), then the
-# remaining step matrices.
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512"
+# remaining step matrices, and last the supervisor kill/resume smoke
+# (fault tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -192,6 +193,30 @@ run_stage() {
             if [ "$rc" -eq 0 ]; then
                 grep -q '"metric": "allreduce_wire_reduction' "$out" \
                     && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        supervisor_smoke)
+            # fault-tolerance e2e ON the chip: a supervised dryrun is
+            # hard-killed mid-run by an injected fault and the supervisor
+            # must auto-resume it to a clean finish. rc 0 alone proves
+            # nothing (a run that never crashed also exits 0): the done
+            # marker requires the runner's JSON summary to show at least
+            # one resume AND a clean outcome. die-at-step 2 fires on any
+            # device count (>=3 host steps even at 1 step/epoch).
+            out="$STATE/supervisor_smoke.out"
+            rm -rf /tmp/tpu_watch_supervisor
+            run_locked "$(stage_timeout 1200)" env SIMCLR_FAULT_DIE_AT_STEP=2 \
+                python -m simclr_tpu.supervisor -- supervised \
+                parameter.epochs=3 parameter.warmup_epochs=0 \
+                experiment.synthetic_data=true experiment.synthetic_size=1024 \
+                experiment.batches=128 supervisor.backoff_base_s=1.0 \
+                experiment.save_dir=/tmp/tpu_watch_supervisor \
+                > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"outcome": "clean"' "$out" \
+                    && grep -Eq '"resumed": [1-9]' "$out"
                 rc=$?
             fi ;;
         bench)

@@ -442,6 +442,52 @@ def _check_runtime_conf(cfg: Config) -> None:
         "runtime.dataset_residency must be 'replicated' or 'sharded'",
     )
     _check_parallel_conf(cfg)
+    _check_supervisor_conf(cfg)
+
+
+def check_supervisor_conf(cfg: Config) -> None:
+    """Validate the ``supervisor.*`` knobs (fault-tolerance policy,
+    docs/FAULT_TOLERANCE.md). Called by the supervisor runner before it
+    spawns anything, and by both training entry points via
+    :func:`_check_runtime_conf` — a bad knob fails at startup on either side
+    of the process boundary. Deliberately jax-free: the runner validates
+    without touching any accelerator state."""
+    _check_supervisor_conf(cfg)
+
+
+def _check_supervisor_conf(cfg: Config) -> None:
+    max_restarts = cfg.select("supervisor.max_restarts", 8)
+    _require(
+        isinstance(max_restarts, int) and 0 <= max_restarts <= 1000,
+        f"supervisor.max_restarts must be an int in [0, 1000], got {max_restarts!r}",
+    )
+    backoff = cfg.select("supervisor.backoff_base_s", 5.0)
+    _require(
+        isinstance(backoff, (int, float)) and 0 <= backoff <= 3600,
+        f"supervisor.backoff_base_s must be in [0, 3600] seconds, got {backoff!r}",
+    )
+    factor = cfg.select("supervisor.heartbeat_timeout_factor", 10.0)
+    _require(
+        isinstance(factor, (int, float)) and 1 <= factor <= 1000,
+        "supervisor.heartbeat_timeout_factor must be in [1, 1000] "
+        f"(multiples of the observed step time), got {factor!r}",
+    )
+    min_timeout = cfg.select("supervisor.heartbeat_min_timeout_s", 30.0)
+    _require(
+        isinstance(min_timeout, (int, float)) and 0 < min_timeout <= 86400,
+        "supervisor.heartbeat_min_timeout_s must be in (0, 86400] seconds, "
+        f"got {min_timeout!r}",
+    )
+    grace = cfg.select("supervisor.startup_grace_s", 600.0)
+    _require(
+        isinstance(grace, (int, float)) and 0 < grace <= 86400,
+        f"supervisor.startup_grace_s must be in (0, 86400] seconds, got {grace!r}",
+    )
+    nan_budget = cfg.select("supervisor.nan_retry_budget", 2)
+    _require(
+        isinstance(nan_budget, int) and 0 <= nan_budget <= 100,
+        f"supervisor.nan_retry_budget must be an int in [0, 100], got {nan_budget!r}",
+    )
 
 
 def _check_parallel_conf(cfg: Config) -> None:

@@ -17,6 +17,9 @@ checkpoint even when ``epochs % save_model_epoch != 0``.
 
 from __future__ import annotations
 
+import itertools
+import json
+import math
 import os
 import sys
 import time
@@ -52,10 +55,17 @@ from simclr_tpu.parallel.steps import (
     make_pretrain_step,
 )
 from simclr_tpu.parallel.train_state import create_train_state, param_count
+from simclr_tpu.supervisor.guard import (
+    PoisonedRun,
+    PreemptedRun,
+    RunGuard,
+    preempt_checkpoint_name,
+    resume_point,
+)
 from simclr_tpu.utils.checkpoint import (
+    CheckpointCorruptionError,
     checkpoint_name,
-    latest_checkpoint,
-    restore_checkpoint,
+    restore_checkpoint_with_fallback,
     save_checkpoint,
 )
 from simclr_tpu.utils.logging import get_logger, is_logging_host
@@ -152,13 +162,28 @@ def run_pretrain(cfg: Config) -> dict:
         state = jax.device_put(state, replicated_sharding(mesh))
 
     save_dir = resolve_save_dir(cfg)
+    # fault-tolerance guard: preemption checkpointing, heartbeat, non-finite
+    # loss rollback (simclr_tpu/supervisor/, docs/FAULT_TOLERANCE.md)
+    guard = RunGuard(
+        save_dir,
+        nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
+    )
     start_epoch = 1
+    skip_steps = 0
     if bool(cfg.select("experiment.resume", False)):
-        ckpt = latest_checkpoint(save_dir)
-        if ckpt is not None:
-            state = restore_checkpoint(ckpt, state)
-            start_epoch = int(state.step) // max(steps_per_epoch, 1) + 1
-            logger.info("Resumed from %s at epoch %d", ckpt, start_epoch)
+        # newest checkpoint whose sha256 sidecar verifies; a corrupt latest
+        # falls back to the previous one instead of failing the run
+        restored, ckpt = restore_checkpoint_with_fallback(save_dir, state)
+        if restored is not None:
+            state = restored
+            start_epoch, skip_steps = resume_point(
+                int(state.step), steps_per_epoch
+            )
+            logger.info(
+                "Resumed from %s at epoch %d%s", ckpt, start_epoch,
+                f" (skipping {skip_steps} already-consumed steps)"
+                if skip_steps else "",
+            )
 
     step_kwargs = dict(
         temperature=float(cfg.parameter.temperature),
@@ -173,6 +198,16 @@ def run_pretrain(cfg: Config) -> dict:
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
     )
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
+    if epoch_compile and skip_steps:
+        # epoch_compile only ever checkpoints at epoch boundaries (the scan
+        # is one indivisible XLA program); a mid-epoch checkpoint must have
+        # come from a per-step-mode run, which can replay the partial epoch
+        raise ValueError(
+            f"checkpoint at step {int(state.step)} is mid-epoch "
+            f"({skip_steps}/{steps_per_epoch} steps into epoch {start_epoch}) "
+            "and cannot resume under runtime.epoch_compile=true; resume with "
+            "runtime.epoch_compile=false"
+        )
     # runtime.dataset_residency: "replicated" keeps the whole dataset in every
     # chip's HBM; "sharded" keeps N/n_data rows per data shard and reassembles
     # each step's batch with one O(global_batch) psum inside the epoch scan
@@ -278,6 +313,40 @@ def run_pretrain(cfg: Config) -> dict:
     # learning artifact, not just a final scalar.
     loss_history: list[list[float]] = []
     monitor_history: list[list[float]] = []
+    if start_epoch > 1:
+        # Re-seat the persisted curves at the resume point so this run
+        # appends [epoch, value] rows without duplicating restored epochs:
+        # rows at or past start_epoch are about to be re-run (the resumed
+        # checkpoint may be older than the last logged epoch) and are
+        # dropped; everything earlier — including the epoch-0 random-init
+        # probe — carries over.
+        prior_path = os.path.join(save_dir, "pretrain_results.json")
+        if os.path.exists(prior_path):
+            try:
+                with open(prior_path) as f:
+                    prior = json.load(f)
+            except ValueError:
+                prior = {}
+            loss_history = [
+                r for r in prior.get("loss_history", []) if r[0] < start_epoch
+            ]
+            monitor_history = [
+                r for r in prior.get("monitor_history", []) if r[0] < start_epoch
+            ]
+
+    def write_results(summary: dict) -> None:
+        """Persist the run summary/curves; called every epoch (not just at
+        the end) so a preempted or crashed run leaves its history for the
+        resume to re-seat."""
+        if not is_logging_host():
+            return
+        from simclr_tpu.utils.ioutil import atomic_write
+
+        atomic_write(
+            os.path.join(save_dir, "pretrain_results.json"),
+            lambda f: json.dump(summary, f, indent=1),
+        )
+
     if eval_every > 0:
         test_ds = load_dataset(
             cfg.experiment.name, "test",
@@ -323,14 +392,15 @@ def run_pretrain(cfg: Config) -> dict:
                     epoch, res["val_acc"], res["val_top_5_acc"],
                 )
             return res["val_acc"]
-    if eval_every > 0 and start_epoch == 1:
+    if eval_every > 0 and start_epoch == 1 and not monitor_history:
         # epoch-0 probe: the RANDOM-INIT accuracy anchors the monitor curve,
         # so a later reader can tell learned features from data that is
-        # already separable to an untrained encoder
+        # already separable to an untrained encoder (skipped when a re-seated
+        # history already carries it)
         monitor_history.append([0, run_monitor_probe(0)])
     # host-side step counter: reading state.step off-device every iteration
     # would sync the host to the in-flight step and kill async dispatch
-    cur_step = (start_epoch - 1) * steps_per_epoch
+    cur_step = (start_epoch - 1) * steps_per_epoch + skip_steps
     # steady-state trace window: skips the first (compiling) step
     tracer = StepTraceWindow(
         cfg.select("experiment.profile_dir"),
@@ -346,49 +416,109 @@ def run_pretrain(cfg: Config) -> dict:
         global_batch * (steps_per_epoch if epoch_compile else 1),
         warmup=1 if epoch_compile else 3,
     )
-    for epoch in range(start_epoch, epochs + 1):
-        if epoch_compile:
-            idx_e = jnp.asarray(
-                epoch_index_matrix(
-                    len(dataset), seed, epoch, steps_per_epoch, global_batch
+    stem = str(cfg.experiment.output_model_name)
+    guard.install_signals()
+    try:
+        epoch = start_epoch
+        while epoch <= epochs:
+            if epoch_compile:
+                idx_e = jnp.asarray(
+                    epoch_index_matrix(
+                        len(dataset), seed, epoch, steps_per_epoch, global_batch
+                    )
                 )
+                state, hist = epoch_fn(state, images_all, idx_e, base_key, cur_step)
+                metrics = {"loss": hist["loss"][-1]}
+                timer.tick(hist["loss"])
+                cur_step += steps_per_epoch
+            else:
+                batches = iterator.batches(epoch)
+                if skip_steps:
+                    # mid-epoch resume: replay the epoch's deterministic
+                    # batch order past the consumed prefix; step RNG folds on
+                    # the absolute cur_step, so the continuation is exact
+                    batches = itertools.islice(batches, skip_steps, None)
+                    skip_steps = 0
+                for batch in prefetch(batches):
+                    tracer.tick(cur_step, pending=metrics["loss"])
+                    step_rng = jax.random.fold_in(base_key, cur_step)
+                    state, metrics = step_fn(state, batch["image"], step_rng)
+                    timer.tick(metrics["loss"])
+                    cur_step += 1
+                    guard.beat(cur_step, epoch)
+                    if guard.preempt_requested:
+                        break
+            if guard.preempt_requested:
+                # land a resumable checkpoint at this step boundary, then
+                # exit 75 via main() — at an exact epoch boundary this is the
+                # regular boundary checkpoint; mid-epoch it gets "-preempt"
+                timer.pause(metrics["loss"])
+                path = os.path.join(
+                    save_dir,
+                    preempt_checkpoint_name(cur_step, steps_per_epoch, stem),
+                )
+                save_checkpoint(path, state)
+                guard.beat_preempted(cur_step, epoch)
+                raise PreemptedRun(path)
+
+            epoch_loss = guard.checked_loss(cur_step, float(metrics["loss"]))
+            guard.beat(cur_step, epoch, loss=epoch_loss)
+            if not math.isfinite(epoch_loss):
+                # roll back to the newest verified checkpoint; a different
+                # RNG stream on the retry — deterministically replaying the
+                # same trajectory would reproduce the same divergence
+                try:
+                    restored, rpath = restore_checkpoint_with_fallback(
+                        save_dir, state
+                    )
+                except CheckpointCorruptionError as e:
+                    raise PoisonedRun(str(e)) from e
+                guard.record_rollback(epoch_loss, rpath)
+                state = restored
+                cur_step = int(state.step)
+                epoch, skip_steps = resume_point(cur_step, steps_per_epoch)
+                loss_history = [r for r in loss_history if r[0] < epoch]
+                monitor_history = [r for r in monitor_history if r[0] < epoch]
+                base_key = jax.random.fold_in(
+                    jax.random.key(seed + 1), guard.nan_rollbacks
+                )
+                continue
+            if is_logging_host():
+                # one line per epoch, the reference's rank-0 log (main.py:124-127)
+                lr_now = float(schedule(max(cur_step - 1, 0)))
+                imgs_per_sec = (
+                    (cur_step - (start_epoch - 1) * steps_per_epoch)
+                    * global_batch / max(time.time() - t_start, 1e-9)
+                )
+                logger.info(
+                    "Epoch:%d/%d progress:%.3f loss:%.3f, lr:%.7f, imgs/sec:%.0f",
+                    epoch, epochs, epoch / epochs, epoch_loss, lr_now,
+                    imgs_per_sec,
+                )
+            loss_history.append([epoch, epoch_loss])
+            if eval_every > 0 and (epoch % eval_every == 0 or epoch == epochs):
+                timer.pause(metrics["loss"])  # keep probe compute out of imgs/sec
+                monitor_val_acc = run_monitor_probe(epoch)
+                monitor_history.append([epoch, monitor_val_acc])
+                timer.resume()
+            if epoch % save_model_epoch == 0 or epoch == epochs:
+                path = os.path.join(save_dir, checkpoint_name(epoch, stem))
+                timer.pause(metrics["loss"])  # keep save I/O out of the imgs/sec window
+                save_checkpoint(path, state)
+                guard.after_save(epoch, path)
+                timer.resume()
+            write_results(
+                {
+                    "epochs": epochs,
+                    "save_dir": save_dir,
+                    "loss_history": loss_history,
+                    "monitor_history": monitor_history,
+                    "complete": False,
+                }
             )
-            state, hist = epoch_fn(state, images_all, idx_e, base_key, cur_step)
-            metrics = {"loss": hist["loss"][-1]}
-            timer.tick(hist["loss"])
-            cur_step += steps_per_epoch
-        else:
-            for batch in prefetch(iterator.batches(epoch)):
-                tracer.tick(cur_step, pending=metrics["loss"])
-                step_rng = jax.random.fold_in(base_key, cur_step)
-                state, metrics = step_fn(state, batch["image"], step_rng)
-                timer.tick(metrics["loss"])
-                cur_step += 1
-        if is_logging_host():
-            # one line per epoch, the reference's rank-0 log (main.py:124-127)
-            lr_now = float(schedule(max(cur_step - 1, 0)))
-            imgs_per_sec = (
-                (cur_step - (start_epoch - 1) * steps_per_epoch)
-                * global_batch / max(time.time() - t_start, 1e-9)
-            )
-            logger.info(
-                "Epoch:%d/%d progress:%.3f loss:%.3f, lr:%.7f, imgs/sec:%.0f",
-                epoch, epochs, epoch / epochs, float(metrics["loss"]), lr_now,
-                imgs_per_sec,
-            )
-        loss_history.append([epoch, float(metrics["loss"])])
-        if eval_every > 0 and (epoch % eval_every == 0 or epoch == epochs):
-            timer.pause(metrics["loss"])  # keep probe compute out of imgs/sec
-            monitor_val_acc = run_monitor_probe(epoch)
-            monitor_history.append([epoch, monitor_val_acc])
-            timer.resume()
-        if epoch % save_model_epoch == 0 or epoch == epochs:
-            path = os.path.join(
-                save_dir, checkpoint_name(epoch, str(cfg.experiment.output_model_name))
-            )
-            timer.pause(metrics["loss"])  # keep save I/O out of the imgs/sec window
-            save_checkpoint(path, state)
-            timer.resume()
+            epoch += 1
+    finally:
+        guard.restore_signals()
 
     tracer.close(pending=metrics["loss"])
     throughput = timer.summary()
@@ -411,33 +541,41 @@ def run_pretrain(cfg: Config) -> dict:
         "imgs_per_sec_steady": throughput["imgs_per_sec"],
     }
     summary["loss_history"] = loss_history
+    summary["complete"] = True
+    if monitor_history:
+        summary["monitor_history"] = monitor_history
+    if monitor_val_acc is None and monitor_history:
+        # resumed with nothing left to run: the last re-seated probe stands
+        monitor_val_acc = monitor_history[-1][1]
     if monitor_val_acc is not None:
         summary["monitor_val_acc"] = monitor_val_acc
-        summary["monitor_history"] = monitor_history
-    if is_logging_host():
-        import json
-
-        from simclr_tpu.utils.ioutil import atomic_write
-
-        atomic_write(
-            os.path.join(save_dir, "pretrain_results.json"),
-            lambda f: json.dump(summary, f, indent=1),
-        )
+    write_results(summary)
     return summary
 
 
 def main(argv: list[str] | None = None):
     from simclr_tpu.config import run_multirun, split_multirun_flag
     from simclr_tpu.parallel.multihost import maybe_initialize_multihost
+    from simclr_tpu.supervisor.guard import EXIT_POISONED, EXIT_PREEMPTED
     from simclr_tpu.utils.platform import ensure_platform
 
     ensure_platform()
     maybe_initialize_multihost()
     multirun, args = split_multirun_flag(list(sys.argv[1:] if argv is None else argv))
-    if multirun:
-        return run_multirun(run_pretrain, "config", args)
-    cfg = load_config("config", overrides=args)
-    return run_pretrain(cfg)
+    # exit-code contract (docs/FAULT_TOLERANCE.md): 75 = preempted but
+    # resumable (the supervisor restarts with resume=true), 76 = poisoned
+    # (restarting cannot help; the supervisor gives up)
+    try:
+        if multirun:
+            return run_multirun(run_pretrain, "config", args)
+        cfg = load_config("config", overrides=args)
+        return run_pretrain(cfg)
+    except PreemptedRun as e:
+        logger.info("%s", e)
+        sys.exit(EXIT_PREEMPTED)
+    except PoisonedRun as e:
+        logger.error("%s", e)
+        sys.exit(EXIT_POISONED)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ scratch on any failure (no checkpoint-load path, SURVEY §5.3).
 
 from __future__ import annotations
 
+import itertools
 import math
 import os
 import sys
@@ -52,12 +53,19 @@ from simclr_tpu.parallel.steps import (
     make_supervised_step,
 )
 from simclr_tpu.parallel.train_state import create_train_state, param_count
+from simclr_tpu.supervisor.guard import (
+    PoisonedRun,
+    PreemptedRun,
+    RunGuard,
+    preempt_checkpoint_name,
+    resume_point,
+)
 from simclr_tpu.utils.checkpoint import (
+    CheckpointCorruptionError,
     checkpoint_name,
     delete_checkpoint,
-    latest_checkpoint,
     list_checkpoints,
-    restore_checkpoint,
+    restore_checkpoint_with_fallback,
     save_checkpoint,
 )
 from simclr_tpu.utils.logging import get_logger, is_logging_host
@@ -221,24 +229,36 @@ def run_supervised(cfg: Config) -> dict:
     best_path = None
     best_epoch = 0
     start_epoch = 1
-    # Resume (VERDICT r3 item 6) — the same latest→restore→start_epoch
-    # mechanism as main.py, adapted to the best-only deletion policy: the
-    # only checkpoint on disk IS the previous best, so training rewinds to
-    # the best epoch (later non-best progress was never persisted, by the
-    # reference's own policy, supervised.py:151-162). One re-validation of
-    # the restored state re-establishes best_value/best_path so the first
-    # post-resume epoch can't spuriously "improve" over None and delete the
-    # checkpoint it just resumed from.
+    skip_steps = 0
+    # fault-tolerance guard: preemption checkpointing, heartbeat, non-finite
+    # loss rollback (simclr_tpu/supervisor/, docs/FAULT_TOLERANCE.md)
+    guard = RunGuard(
+        save_dir,
+        nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
+    )
+    # Resume (VERDICT r3 item 6) — the same restore→start_epoch mechanism as
+    # main.py, adapted to the best-only deletion policy: normally the only
+    # checkpoint on disk IS the previous best, so training rewinds to the
+    # best epoch; a "-preempt" checkpoint (newer) wins when present. The
+    # fallback restore happens BEFORE any stale-checkpoint cleanup — a
+    # corrupt newest must be able to fall back to the older one, so deleting
+    # first would destroy the very candidates the fallback needs. One
+    # re-validation of the restored state re-establishes best_value/best_path
+    # so the first post-resume epoch can't spuriously "improve" over None and
+    # delete the checkpoint it just resumed from.
     if bool(cfg.select("experiment.resume", False)):
-        ckpt = latest_checkpoint(save_dir)
-        if ckpt is not None:
-            # a crash between save-new-best and delete-old-best can leave two
-            # checkpoints; keep the newest (it won the comparison) and
-            # restore the best-only invariant
-            for stale in list_checkpoints(save_dir)[:-1]:
-                delete_checkpoint(stale)
-            state = restore_checkpoint(ckpt, state)
-            start_epoch = int(state.step) // max(steps_per_epoch, 1) + 1
+        restored, ckpt = restore_checkpoint_with_fallback(save_dir, state)
+        if restored is not None:
+            state = restored
+            # best-only invariant restored AFTER the successful restore:
+            # drop everything except what we actually resumed from (stale
+            # best from a crash window, preempt checkpoints, corrupt newest)
+            for stale in list_checkpoints(save_dir):
+                if os.path.abspath(stale) != os.path.abspath(ckpt):
+                    delete_checkpoint(stale)
+            start_epoch, skip_steps = resume_point(
+                int(state.step), steps_per_epoch
+            )
             val_loss, val_acc = run_validation(state)
             best_value = val_loss if metric == "loss" else val_acc
             best_path = ckpt
@@ -248,10 +268,17 @@ def run_supervised(cfg: Config) -> dict:
                     "Resumed from %s at epoch %d (best %s=%.4f re-validated)",
                     ckpt, start_epoch, metric, best_value,
                 )
+    if epoch_compile and skip_steps:
+        raise ValueError(
+            f"checkpoint at step {int(state.step)} is mid-epoch "
+            f"({skip_steps}/{steps_per_epoch} steps into epoch {start_epoch}) "
+            "and cannot resume under runtime.epoch_compile=true; resume with "
+            "runtime.epoch_compile=false"
+        )
     history = []
     t_start = time.time()
     # host-side mirror of state.step: avoids per-step device sync
-    cur_step = (start_epoch - 1) * steps_per_epoch
+    cur_step = (start_epoch - 1) * steps_per_epoch + skip_steps
     # steady-state training throughput like main.py's: validation sweeps and
     # checkpoint I/O are pause()d out of the timed window. In epoch_compile
     # mode one tick covers a whole epoch of steps.
@@ -268,65 +295,119 @@ def run_supervised(cfg: Config) -> dict:
     # bound before the loop: a resume whose start_epoch exceeds epochs (the
     # run already completed) must still reach tracer.close/timer.summary
     train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
-    for epoch in range(start_epoch, epochs + 1):
-        if epoch_compile:
-            idx_e = jnp.asarray(
-                epoch_index_matrix(
-                    len(train_ds), seed, epoch, steps_per_epoch, global_batch
+    stem = f"supervised-{cfg.experiment.name}.pt"
+    guard.install_signals()
+    try:
+        epoch = start_epoch
+        while epoch <= epochs:
+            if epoch_compile:
+                idx_e = jnp.asarray(
+                    epoch_index_matrix(
+                        len(train_ds), seed, epoch, steps_per_epoch, global_batch
+                    )
                 )
-            )
-            state, epoch_metrics = epoch_fn(
-                state, images_all, labels_all, idx_e, base_key, cur_step
-            )
-            train_metrics = {k: v[-1] for k, v in epoch_metrics.items()}
-            timer.tick(epoch_metrics["loss"])
-            cur_step += steps_per_epoch
-        else:
-            for batch in prefetch(train_iter.batches(epoch)):
-                tracer.tick(cur_step, pending=train_metrics["loss"])
-                step_rng = jax.random.fold_in(base_key, cur_step)
-                state, train_metrics = train_step(
-                    state, batch["image"], batch["label"], step_rng
+                state, epoch_metrics = epoch_fn(
+                    state, images_all, labels_all, idx_e, base_key, cur_step
                 )
-                timer.tick(train_metrics["loss"])
-                cur_step += 1
+                train_metrics = {k: v[-1] for k, v in epoch_metrics.items()}
+                timer.tick(epoch_metrics["loss"])
+                cur_step += steps_per_epoch
+            else:
+                batches = train_iter.batches(epoch)
+                if skip_steps:
+                    # mid-epoch resume: replay the epoch's deterministic batch
+                    # order past the consumed prefix (step RNG folds on the
+                    # absolute cur_step, so the continuation is exact)
+                    batches = itertools.islice(batches, skip_steps, None)
+                    skip_steps = 0
+                for batch in prefetch(batches):
+                    tracer.tick(cur_step, pending=train_metrics["loss"])
+                    step_rng = jax.random.fold_in(base_key, cur_step)
+                    state, train_metrics = train_step(
+                        state, batch["image"], batch["label"], step_rng
+                    )
+                    timer.tick(train_metrics["loss"])
+                    cur_step += 1
+                    guard.beat(cur_step, epoch)
+                    if guard.preempt_requested:
+                        break
+            if guard.preempt_requested:
+                # land a resumable checkpoint (alongside the untouched best),
+                # then exit 75 via main(); resume restores this newest state
+                # and re-establishes the best-only invariant
+                timer.pause(train_metrics["loss"])
+                path = os.path.join(
+                    save_dir,
+                    preempt_checkpoint_name(cur_step, steps_per_epoch, stem),
+                )
+                save_checkpoint(path, state)
+                guard.beat_preempted(cur_step, epoch)
+                raise PreemptedRun(path)
 
-        timer.pause(train_metrics["loss"])  # keep eval out of the imgs/sec window
-        val_loss, val_acc = run_validation(state)
-        history.append({"epoch": epoch, "val_loss": val_loss, "val_acc": val_acc})
-        if is_logging_host():
-            imgs_per_sec = (
-                (cur_step - (start_epoch - 1) * steps_per_epoch)
-                * global_batch / max(time.time() - t_start, 1e-9)
+            epoch_loss = guard.checked_loss(
+                cur_step, float(train_metrics["loss"])
             )
-            logger.info(
-                "Epoch:%d/%d progress:%.3f train_loss:%.3f val_loss:%.4f "
-                "val_acc:%.4f lr:%.7f imgs/sec(cum):%.0f",
-                epoch, epochs, epoch / epochs, float(train_metrics["loss"]),
-                val_loss, val_acc, float(schedule(max(cur_step - 1, 0))),
-                imgs_per_sec,
-            )
+            guard.beat(cur_step, epoch, loss=epoch_loss)
+            if not math.isfinite(epoch_loss):
+                # roll back to the newest verified checkpoint; a different
+                # RNG stream on the retry (see main.py)
+                try:
+                    rolled, rpath = restore_checkpoint_with_fallback(
+                        save_dir, state
+                    )
+                except CheckpointCorruptionError as e:
+                    raise PoisonedRun(str(e)) from e
+                guard.record_rollback(epoch_loss, rpath)
+                state = rolled
+                cur_step = int(state.step)
+                epoch, skip_steps = resume_point(cur_step, steps_per_epoch)
+                history = [h for h in history if h["epoch"] < epoch]
+                val_loss, val_acc = run_validation(state)
+                best_value = val_loss if metric == "loss" else val_acc
+                best_path = rpath
+                best_epoch = epoch - 1
+                base_key = jax.random.fold_in(
+                    jax.random.key(seed + 1), guard.nan_rollbacks
+                )
+                continue
 
-        # best-only checkpoint policy (reference supervised.py:144-162)
-        value = val_loss if metric == "loss" else val_acc
-        improved = best_value is None or (
-            value < best_value if metric == "loss" else value > best_value
-        )
-        if improved:
-            # save the NEW best before deleting the old one: a crash between
-            # the two must leave at least one resumable checkpoint on disk
-            # (orbax writes are atomic; epoch-numbered names never collide)
-            prev_best = best_path
-            best_value = value
-            best_epoch = epoch
-            best_path = os.path.join(
-                save_dir,
-                checkpoint_name(epoch, f"supervised-{cfg.experiment.name}.pt"),
+            timer.pause(train_metrics["loss"])  # keep eval out of the imgs/sec window
+            val_loss, val_acc = run_validation(state)
+            history.append({"epoch": epoch, "val_loss": val_loss, "val_acc": val_acc})
+            if is_logging_host():
+                imgs_per_sec = (
+                    (cur_step - (start_epoch - 1) * steps_per_epoch)
+                    * global_batch / max(time.time() - t_start, 1e-9)
+                )
+                logger.info(
+                    "Epoch:%d/%d progress:%.3f train_loss:%.3f val_loss:%.4f "
+                    "val_acc:%.4f lr:%.7f imgs/sec(cum):%.0f",
+                    epoch, epochs, epoch / epochs, epoch_loss,
+                    val_loss, val_acc, float(schedule(max(cur_step - 1, 0))),
+                    imgs_per_sec,
+                )
+
+            # best-only checkpoint policy (reference supervised.py:144-162)
+            value = val_loss if metric == "loss" else val_acc
+            improved = best_value is None or (
+                value < best_value if metric == "loss" else value > best_value
             )
-            save_checkpoint(best_path, state)
-            if prev_best is not None:
-                delete_checkpoint(prev_best)
-        timer.resume()
+            if improved:
+                # save the NEW best before deleting the old one: a crash between
+                # the two must leave at least one resumable checkpoint on disk
+                # (orbax writes are atomic; epoch-numbered names never collide)
+                prev_best = best_path
+                best_value = value
+                best_epoch = epoch
+                best_path = os.path.join(save_dir, checkpoint_name(epoch, stem))
+                save_checkpoint(best_path, state)
+                guard.after_save(epoch, best_path)
+                if prev_best is not None:
+                    delete_checkpoint(prev_best)
+            timer.resume()
+            epoch += 1
+    finally:
+        guard.restore_signals()
 
     tracer.close(pending=train_metrics["loss"])
     throughput = timer.summary()
@@ -366,12 +447,22 @@ def main(argv: list[str] | None = None):
     ensure_platform()
     maybe_initialize_multihost()
     from simclr_tpu.config import run_multirun, split_multirun_flag
+    from simclr_tpu.supervisor.guard import EXIT_POISONED, EXIT_PREEMPTED
 
     multirun, args = split_multirun_flag(list(sys.argv[1:] if argv is None else argv))
-    if multirun:
-        return run_multirun(run_supervised, "supervised_config", args)
-    cfg = load_config("supervised_config", overrides=args)
-    return run_supervised(cfg)
+    # exit-code contract (docs/FAULT_TOLERANCE.md): 75 = preempted but
+    # resumable, 76 = poisoned (restarting cannot help)
+    try:
+        if multirun:
+            return run_multirun(run_supervised, "supervised_config", args)
+        cfg = load_config("supervised_config", overrides=args)
+        return run_supervised(cfg)
+    except PreemptedRun as e:
+        logger.info("%s", e)
+        sys.exit(EXIT_PREEMPTED)
+    except PoisonedRun as e:
+        logger.error("%s", e)
+        sys.exit(EXIT_POISONED)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,44 @@
+"""Fault-tolerant run supervision (docs/FAULT_TOLERANCE.md).
+
+Two halves: the in-process :class:`RunGuard` (preemption checkpointing,
+heartbeat, non-finite-loss rollback — wired into ``main.py`` and
+``supervised.py``) and the out-of-process supervisor runner
+(``python -m simclr_tpu.supervisor`` — hang detection, backed-off restarts,
+outcome classification).
+"""
+
+from simclr_tpu.supervisor.faults import FAULT_CRASH_CODE, FaultPlan
+from simclr_tpu.supervisor.guard import (
+    EXIT_POISONED,
+    EXIT_PREEMPTED,
+    PoisonedRun,
+    PreemptedRun,
+    RunGuard,
+    nonfinite,
+    preempt_checkpoint_name,
+    resume_point,
+)
+from simclr_tpu.supervisor.heartbeat import (
+    heartbeat_path,
+    read_heartbeat,
+    write_heartbeat,
+)
+from simclr_tpu.supervisor.runner import SupervisorKnobs, supervise
+
+__all__ = [
+    "FAULT_CRASH_CODE",
+    "FaultPlan",
+    "EXIT_POISONED",
+    "EXIT_PREEMPTED",
+    "PoisonedRun",
+    "PreemptedRun",
+    "RunGuard",
+    "nonfinite",
+    "preempt_checkpoint_name",
+    "resume_point",
+    "heartbeat_path",
+    "read_heartbeat",
+    "write_heartbeat",
+    "SupervisorKnobs",
+    "supervise",
+]

@@ -1,0 +1,8 @@
+"""``python -m simclr_tpu.supervisor -- <entrypoint> <overrides…>``."""
+
+import sys
+
+from simclr_tpu.supervisor.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""Deterministic, env-gated fault injection for the fault-tolerance suite.
+
+Every recovery path the supervisor promises (crash restart, hang kill, NaN
+rollback, corrupt-checkpoint fallback) is only trustworthy if it can be
+exercised on demand, on CPU, in CI. These hooks inject the faults at fixed
+step/epoch boundaries so the e2e tests are reproducible:
+
+  ``SIMCLR_FAULT_DIE_AT_STEP=K``       hard-exit (``os._exit``) once the host
+                                       step counter reaches K — a crash with
+                                       no cleanup, like a SIGKILL/OOM.
+  ``SIMCLR_FAULT_WEDGE_AT_STEP=K``     stop beating and sleep forever at step
+                                       K — a wedged device loop; only the
+                                       supervisor's hang detection gets you out.
+  ``SIMCLR_FAULT_NAN_AT_STEP=K``       report the first epoch-boundary loss at
+                                       or after step K as NaN — drives the
+                                       non-finite-loss rollback.
+  ``SIMCLR_FAULT_CORRUPT_AT_EPOCH=E``  flip a byte in the epoch-E checkpoint
+                                       right after it is saved (sidecar left
+                                       stale) — the restore fallback path.
+
+Each fault fires ONCE PER RUN DIRECTORY, recorded by a marker file in
+``save_dir``: a supervisor restart re-executes the same env, and without the
+marker the replayed child would die at the same step forever. Stdlib-only —
+the supervisor runner and tests import this without jax.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+ENV_DIE = "SIMCLR_FAULT_DIE_AT_STEP"
+ENV_WEDGE = "SIMCLR_FAULT_WEDGE_AT_STEP"
+ENV_NAN = "SIMCLR_FAULT_NAN_AT_STEP"
+ENV_CORRUPT = "SIMCLR_FAULT_CORRUPT_AT_EPOCH"
+
+# distinct from every meaningful code in the exit-code contract
+# (docs/FAULT_TOLERANCE.md) so a fault-crash never masquerades as a
+# preemption (75) or poisoning (76)
+FAULT_CRASH_CODE = 13
+
+
+def _env_int(name: str) -> int | None:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    return int(raw)
+
+
+class FaultPlan:
+    """The armed faults for one run directory (all disarmed when the env is
+    clean — the production case; every hook is then a no-op compare)."""
+
+    def __init__(self, save_dir: str):
+        self.save_dir = save_dir
+        self.die_at_step = _env_int(ENV_DIE)
+        self.wedge_at_step = _env_int(ENV_WEDGE)
+        self.nan_at_step = _env_int(ENV_NAN)
+        self.corrupt_at_epoch = _env_int(ENV_CORRUPT)
+
+    # -- once-per-run-dir markers ------------------------------------------
+    def _marker(self, kind: str) -> str:
+        return os.path.join(self.save_dir, f".fault_fired.{kind}")
+
+    def _fired(self, kind: str) -> bool:
+        return os.path.exists(self._marker(kind))
+
+    def _fire(self, kind: str) -> None:
+        os.makedirs(self.save_dir, exist_ok=True)
+        with open(self._marker(kind), "w") as f:
+            f.write(f"{time.time()}\n")
+
+    # -- hooks --------------------------------------------------------------
+    def maybe_die(self, step: int) -> None:
+        if self.die_at_step is None or step < self.die_at_step or self._fired("die"):
+            return
+        self._fire("die")
+        # _exit: no atexit, no finally, no orbax cleanup — a real hard crash
+        os._exit(FAULT_CRASH_CODE)
+
+    def maybe_wedge(self, step: int) -> None:
+        if (
+            self.wedge_at_step is None
+            or step < self.wedge_at_step
+            or self._fired("wedge")
+        ):
+            return
+        self._fire("wedge")
+        while True:  # beats stop; only SIGKILL ends this
+            time.sleep(3600)
+
+    def maybe_nan(self, step: int, loss: float) -> float:
+        if self.nan_at_step is None or step < self.nan_at_step or self._fired("nan"):
+            return loss
+        self._fire("nan")
+        return float("nan")
+
+    def maybe_corrupt(self, epoch: int, checkpoint_path: str) -> None:
+        if (
+            self.corrupt_at_epoch is None
+            or epoch < self.corrupt_at_epoch
+            or self._fired("corrupt")
+        ):
+            return
+        self._fire("corrupt")
+        corrupt_checkpoint_bytes(checkpoint_path)
+
+
+def corrupt_checkpoint_bytes(path: str) -> None:
+    """Flip one byte mid-way through the checkpoint's largest file without
+    touching the sha256 sidecar — exactly the bit-rot/truncation class the
+    sidecar verification exists to catch."""
+    files = [
+        os.path.join(root, name)
+        for root, _dirs, names in os.walk(path)
+        for name in names
+    ]
+    files = [f for f in files if os.path.getsize(f) > 0]
+    if not files:
+        raise FileNotFoundError(f"no files to corrupt under {path!r}")
+    victim = max(files, key=os.path.getsize)
+    offset = os.path.getsize(victim) // 2
+    with open(victim, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0xFF]))

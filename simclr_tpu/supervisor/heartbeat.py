@@ -1,0 +1,81 @@
+"""Heartbeat file — the liveness channel between a run and its supervisor.
+
+The training process (process 0 only) rewrites one small JSON file at every
+host-loop step boundary; the supervisor runner tails it to tell "slow" from
+"wedged" (``runner.py``). The write is an atomic rename so a reader never
+sees a torn file, but deliberately does NOT fsync: a heartbeat is a liveness
+signal, not a durable artifact — losing the last beat in a power cut is
+indistinguishable from dying one step earlier, while an fsync per step would
+put a disk flush on the training hot loop (``utils/ioutil.atomic_write``
+keeps the fsync for artifacts a resume gate later trusts).
+
+This module is stdlib-only: the supervisor runner imports it without paying
+for (or risking any device touch through) jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+HEARTBEAT_NAME = "heartbeat.json"
+
+# status values a beat can carry; the supervisor only keys off file CHANGE
+# (any rewrite proves the host loop is alive), status is for humans and tests
+STATUS_RUNNING = "running"
+STATUS_PREEMPTED = "preempted"
+
+
+def heartbeat_path(save_dir: str) -> str:
+    """The run's heartbeat file, fixed relative to ``save_dir`` so the
+    supervisor can find it without any channel to the child but argv."""
+    return os.path.join(save_dir, HEARTBEAT_NAME)
+
+
+def write_heartbeat(
+    path: str,
+    *,
+    step: int,
+    epoch: int,
+    loss: float | None = None,
+    status: str = STATUS_RUNNING,
+) -> None:
+    """Atomically rewrite the heartbeat (rename, no fsync — see module doc)."""
+    payload = {
+        "step": int(step),
+        "epoch": int(epoch),
+        "time": time.time(),
+        "loss": None if loss is None else float(loss),
+        "pid": os.getpid(),
+        "status": status,
+    }
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", prefix=os.path.basename(path) + ".tmp."
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """Parse the heartbeat; ``None`` when absent or unreadable.
+
+    A torn/garbage file is treated like no beat at all rather than an error:
+    the supervisor's only decision is "has anything changed lately", and the
+    atomic writer makes garbage transient.
+    """
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None

@@ -1,0 +1,335 @@
+"""Supervisor runner: spawn, watch, restart.
+
+``python -m simclr_tpu.supervisor -- <entrypoint> <overrides…>`` wraps a
+training entry point the way Podracer-style fleets wrap their learners
+(arXiv:2104.06272 §2: preemption is the normal case, restart-from-checkpoint
+is the recovery): the entry point runs as a child process, the supervisor
+tails its heartbeat file, and every way the child can stop — clean exit,
+preemption (75), crash, poisoning (76), or a wedged loop that stops beating —
+maps to either a backed-off restart (with ``experiment.resume=true`` forced)
+or a terminal outcome in the supervisor's own exit code and one-line JSON
+summary.
+
+The supervisor itself never touches accelerators: the child owns the chips,
+and a restart must start from a clean device state. Importing this module
+pulls jax transitively (package ``__init__``), but no jax API is ever called
+here — backend initialisation stays un-triggered in the supervisor process.
+
+Exit-code contract (shared with ``guard.py``; docs/FAULT_TOLERANCE.md):
+  0   clean — the run finished (possibly after restarts; see ``resumed``)
+  75  preempted — stopped resumably (budget exhausted on preempts, or the
+      supervisor itself was told to stop and drained the child)
+  76  poisoned — the child declared retrying useless; NOT restarted
+  else  crashed — the child's last exit code, after the retry budget
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from simclr_tpu.supervisor.guard import EXIT_POISONED, EXIT_PREEMPTED
+from simclr_tpu.supervisor.heartbeat import heartbeat_path, read_heartbeat
+
+OUTCOME_CLEAN = "clean"
+OUTCOME_PREEMPTED = "preempted"
+OUTCOME_CRASHED = "crashed"
+OUTCOME_POISONED = "poisoned"
+
+# the attempt ordinal, exported to the child for log-line tagging
+ENV_ATTEMPT = "SIMCLR_SUPERVISOR_ATTEMPT"
+
+SUMMARY_NAME = "supervisor_summary.json"
+
+# entrypoint alias -> (python -m module, root config name for knob/save_dir
+# resolution). The supervisor composes the SAME config the child will, so
+# supervisor.* overrides and experiment.save_dir resolve identically.
+ENTRYPOINTS = {
+    "pretrain": ("simclr_tpu.main", "config"),
+    "main": ("simclr_tpu.main", "config"),
+    "simclr_tpu.main": ("simclr_tpu.main", "config"),
+    "supervised": ("simclr_tpu.supervised", "supervised_config"),
+    "simclr_tpu.supervised": ("simclr_tpu.supervised", "supervised_config"),
+}
+
+
+@dataclasses.dataclass
+class SupervisorKnobs:
+    """Restart/backoff/hang-detection policy (``supervisor.*`` config keys,
+    validated by ``config.check_supervisor_conf``)."""
+
+    max_restarts: int = 8
+    backoff_base_s: float = 5.0
+    heartbeat_timeout_factor: float = 10.0
+    heartbeat_min_timeout_s: float = 30.0
+    startup_grace_s: float = 600.0
+
+    @classmethod
+    def from_config(cls, cfg) -> "SupervisorKnobs":
+        d = cls()
+        return cls(
+            max_restarts=int(cfg.select("supervisor.max_restarts", d.max_restarts)),
+            backoff_base_s=float(
+                cfg.select("supervisor.backoff_base_s", d.backoff_base_s)
+            ),
+            heartbeat_timeout_factor=float(
+                cfg.select(
+                    "supervisor.heartbeat_timeout_factor", d.heartbeat_timeout_factor
+                )
+            ),
+            heartbeat_min_timeout_s=float(
+                cfg.select(
+                    "supervisor.heartbeat_min_timeout_s", d.heartbeat_min_timeout_s
+                )
+            ),
+            startup_grace_s=float(
+                cfg.select("supervisor.startup_grace_s", d.startup_grace_s)
+            ),
+        )
+
+
+class _BeatTracker:
+    """Distinguishes slow from wedged for ONE child attempt.
+
+    Any rewrite of the heartbeat file counts as a beat (the payload carries a
+    wall-time field, so every write changes the fingerprint). The allowed gap
+    adapts to the observed cadence: an EWMA of inter-beat intervals times
+    ``heartbeat_timeout_factor``, floored by ``heartbeat_min_timeout_s`` so a
+    fast loop's jitter can't trip it. Before the first NEW beat (a stale file
+    from the previous attempt does not count) the child gets
+    ``startup_grace_s`` — the compile window on real runs.
+    """
+
+    _EWMA_ALPHA = 0.3
+
+    def __init__(self, knobs: SupervisorKnobs, baseline: dict | None, now: float):
+        self.knobs = knobs
+        self.started = now
+        self.last_change: float | None = None
+        self.ewma: float | None = None
+        self._fingerprint = self._fp(baseline)
+
+    @staticmethod
+    def _fp(payload: dict | None):
+        if payload is None:
+            return None
+        return (payload.get("pid"), payload.get("step"), payload.get("time"))
+
+    def observe(self, payload: dict | None, now: float) -> None:
+        fp = self._fp(payload)
+        if payload is None or fp == self._fingerprint:
+            return
+        if self.last_change is not None:
+            interval = now - self.last_change
+            self.ewma = (
+                interval
+                if self.ewma is None
+                else (1 - self._EWMA_ALPHA) * self.ewma + self._EWMA_ALPHA * interval
+            )
+        self._fingerprint = fp
+        self.last_change = now
+
+    def timed_out(self, now: float) -> bool:
+        if self.last_change is None:
+            return now - self.started > self.knobs.startup_grace_s
+        limit = self.knobs.heartbeat_min_timeout_s
+        if self.ewma is not None:
+            limit = max(limit, self.knobs.heartbeat_timeout_factor * self.ewma)
+        return now - self.last_change > limit
+
+
+def _write_summary(save_dir: str, summary: dict) -> None:
+    path = os.path.join(save_dir, SUMMARY_NAME)
+    fd, tmp = tempfile.mkstemp(dir=save_dir, prefix=SUMMARY_NAME + ".tmp.")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(summary, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def supervise(
+    cmd: list[str],
+    save_dir: str,
+    knobs: SupervisorKnobs,
+    *,
+    resume_args: tuple[str, ...] | list[str] = (),
+    env: dict | None = None,
+) -> dict:
+    """Run ``cmd`` under supervision until a terminal outcome; returns the
+    summary dict (also written to ``<save_dir>/supervisor_summary.json``).
+
+    ``resume_args`` are appended to the command on every attempt AFTER the
+    first — the entry points apply overrides in order, so a trailing
+    ``experiment.resume=true`` wins whatever the caller passed.
+    """
+    os.makedirs(save_dir, exist_ok=True)
+    hb_path = heartbeat_path(save_dir)
+    # poll fast enough to resolve the configured minimum timeout
+    poll_s = min(0.5, max(0.05, knobs.heartbeat_min_timeout_s / 4.0))
+
+    restarts = {"preempted": 0, "crashed": 0, "hung": 0}
+    stop_signal: dict[str, int | None] = {"sig": None}
+    child: dict[str, subprocess.Popen | None] = {"proc": None}
+
+    def _forward_stop(signum, frame):
+        # first stop request: drain the child (its guard checkpoints and
+        # exits 75); repeated requests escalate to SIGKILL
+        proc = child["proc"]
+        escalate = stop_signal["sig"] is not None
+        stop_signal["sig"] = signum
+        if proc is not None and proc.poll() is None:
+            proc.kill() if escalate else proc.send_signal(signum)
+
+    previous_handlers = {}
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            previous_handlers[sig] = signal.signal(sig, _forward_stop)
+
+    t0 = time.monotonic()
+    attempt = 0
+    last_rc: int | None = None
+
+    def _summary(outcome: str, exit_code: int) -> dict:
+        summary = {
+            "outcome": outcome,
+            "exit": exit_code,
+            "attempts": attempt,
+            "resumed": attempt - 1,
+            "restarts": dict(restarts),
+            "final_child_exit": last_rc,
+            "save_dir": save_dir,
+            "wall_time_s": round(time.monotonic() - t0, 3),
+        }
+        _write_summary(save_dir, summary)
+        return summary
+
+    try:
+        while True:
+            attempt += 1
+            full_cmd = list(cmd) + (list(resume_args) if attempt > 1 else [])
+            child_env = dict(os.environ if env is None else env)
+            child_env[ENV_ATTEMPT] = str(attempt)
+            tracker = _BeatTracker(knobs, read_heartbeat(hb_path), time.monotonic())
+            proc = subprocess.Popen(full_cmd, env=child_env)
+            child["proc"] = proc
+            hung = False
+            while True:
+                try:
+                    rc = proc.wait(timeout=poll_s)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                now = time.monotonic()
+                tracker.observe(read_heartbeat(hb_path), now)
+                if stop_signal["sig"] is None and tracker.timed_out(now):
+                    # wedged: no beat within the adaptive window. SIGKILL —
+                    # a hung SPMD program won't honor anything gentler
+                    hung = True
+                    proc.kill()
+                    rc = proc.wait()
+                    break
+            child["proc"] = None
+            last_rc = rc
+
+            if not hung and rc == 0:
+                return _summary(OUTCOME_CLEAN, 0)
+            if not hung and rc == EXIT_POISONED:
+                # retrying cannot help (NaN budget exhausted / no verified
+                # checkpoint): restarting would loop the same failure
+                return _summary(OUTCOME_POISONED, EXIT_POISONED)
+            if stop_signal["sig"] is not None:
+                # the stop was ours (forwarded); never count it as a crash
+                return _summary(OUTCOME_PREEMPTED, EXIT_PREEMPTED)
+
+            kind = (
+                "hung" if hung else "preempted" if rc == EXIT_PREEMPTED else "crashed"
+            )
+            total = sum(restarts.values())
+            if total >= knobs.max_restarts:
+                if kind == "preempted":
+                    return _summary(OUTCOME_PREEMPTED, EXIT_PREEMPTED)
+                exit_code = rc if 0 < rc < 256 else 1
+                return _summary(OUTCOME_CRASHED, exit_code)
+            restarts[kind] += 1
+            backoff = knobs.backoff_base_s * (2.0 ** total)
+            print(
+                f"supervisor: child {kind} (exit {rc}); restart "
+                f"{total + 1}/{knobs.max_restarts} in {backoff:.1f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            deadline = time.monotonic() + backoff
+            while time.monotonic() < deadline:
+                if stop_signal["sig"] is not None:
+                    return _summary(OUTCOME_PREEMPTED, EXIT_PREEMPTED)
+                time.sleep(min(poll_s, max(deadline - time.monotonic(), 0.0)))
+    finally:
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        for sig, handler in previous_handlers.items():
+            signal.signal(sig, handler)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: ``python -m simclr_tpu.supervisor -- <entrypoint> <overrides…>``."""
+    from simclr_tpu.config import (
+        ConfigError,
+        check_supervisor_conf,
+        load_config,
+        resolve_save_dir,
+    )
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    if args and args[0] == "--":
+        args = args[1:]
+    if not args or args[0] not in ENTRYPOINTS:
+        known = ", ".join(sorted(set(ENTRYPOINTS)))
+        print(
+            "usage: python -m simclr_tpu.supervisor -- <entrypoint> [overrides...]\n"
+            f"  entrypoint: one of {known}",
+            file=sys.stderr,
+        )
+        return 2
+    module, config_name = ENTRYPOINTS[args[0]]
+    overrides = args[1:]
+    if any(a in ("--multirun", "-m") for a in overrides):
+        print(
+            "supervisor: --multirun is not supported (one supervisor per run; "
+            "wrap each sweep job separately)",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        cfg = load_config(config_name, overrides=overrides)
+        check_supervisor_conf(cfg)
+        knobs = SupervisorKnobs.from_config(cfg)
+        save_dir = resolve_save_dir(cfg)
+    except ConfigError as e:
+        print(f"supervisor: {e}", file=sys.stderr)
+        return 2
+    if not cfg.select("experiment.save_dir"):
+        # pin the resolved (timestamped) run dir: every restart must land in
+        # the SAME directory or resume would never find the checkpoints
+        overrides = overrides + [f"experiment.save_dir={save_dir}"]
+
+    cmd = [sys.executable, "-m", module, *overrides]
+    summary = supervise(
+        cmd, save_dir, knobs, resume_args=("experiment.resume=true",)
+    )
+    print(json.dumps(summary), flush=True)
+    return int(summary["exit"])

@@ -100,7 +100,19 @@ def list_checkpoints(target_dir: str) -> list[str]:
             continue
         if os.path.isdir(full) and _EPOCH_RE.search(entry):
             out.append(full)
-    return sorted(out, key=epoch_of)
+    # Within an epoch, a "-preempt" checkpoint sorts AFTER the plain boundary
+    # checkpoint: it was taken mid-way through the NEXT epoch, so it holds
+    # strictly more steps. (A name tiebreak alone would get this wrong for
+    # stems that sort before "preempt", e.g. "epoch=2-model" < "epoch=2-model-preempt"
+    # but "epoch=2-preempt-…" < "epoch=2-supervised-…".)
+    return sorted(
+        out,
+        key=lambda p: (
+            epoch_of(p),
+            1 if "-preempt" in os.path.basename(p) else 0,
+            os.path.basename(p),
+        ),
+    )
 
 
 def list_checkpoints_or_raise(target_dir: str) -> list[str]:
@@ -215,3 +227,42 @@ def latest_checkpoint(save_dir: str) -> str | None:
     """Newest checkpoint in a run dir, for ``--resume`` semantics."""
     ckpts = list_checkpoints(save_dir)
     return ckpts[-1] if ckpts else None
+
+
+def restore_checkpoint_with_fallback(save_dir: str, target=None):
+    """Restore the newest checkpoint whose sha256 sidecar verifies.
+
+    Walks the run's checkpoints newest-first; a corrupt one (digest mismatch)
+    is logged and skipped, and restore falls back to the next-older verified
+    checkpoint — losing a few epochs of progress beats losing the run.
+    Returns ``(restored, path)``, or ``(None, None)`` when the directory holds
+    no checkpoints at all (a fresh run). Raises
+    :class:`CheckpointCorruptionError` only when checkpoints exist but NONE
+    verifies — there is nothing trustworthy to resume from.
+    """
+    from simclr_tpu.utils.logging import get_logger
+
+    ckpts = list_checkpoints(save_dir)
+    if not ckpts:
+        return None, None
+    skipped = []
+    for path in reversed(ckpts):
+        try:
+            restored = restore_checkpoint(path, target)
+        except CheckpointCorruptionError as e:
+            skipped.append(path)
+            get_logger().warning(
+                "skipping corrupt checkpoint %s (%s); falling back to the "
+                "previous one", path, e,
+            )
+            continue
+        if skipped:
+            get_logger().warning(
+                "restored %s after skipping %d corrupt checkpoint(s): %s",
+                path, len(skipped), ", ".join(os.path.basename(p) for p in skipped),
+            )
+        return restored, path
+    raise CheckpointCorruptionError(
+        f"all {len(ckpts)} checkpoint(s) under {save_dir!r} fail sha256 "
+        f"verification; nothing trustworthy to resume from"
+    )

@@ -9,6 +9,7 @@ process per host; process 0 is the logging host (the rank-0 analogue).
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 import jax
@@ -18,7 +19,13 @@ def get_logger(name: str = "simclr_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+        # under the supervisor runner each restart tags its lines with the
+        # attempt ordinal, so an interleaved log reads unambiguously
+        attempt = os.environ.get("SIMCLR_SUPERVISOR_ATTEMPT", "").strip()
+        tag = f" [attempt {attempt}]" if attempt else ""
+        handler.setFormatter(
+            logging.Formatter(f"%(asctime)s %(levelname)s{tag} %(message)s")
+        )
         logger.addHandler(handler)
         logger.setLevel(logging.INFO)
         logger.propagate = False

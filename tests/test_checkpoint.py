@@ -19,6 +19,7 @@ from simclr_tpu.utils.checkpoint import (
     latest_checkpoint,
     list_checkpoints,
     restore_checkpoint,
+    restore_checkpoint_with_fallback,
     save_checkpoint,
     verify_checkpoint,
 )
@@ -53,6 +54,31 @@ class TestNaming:
 
     def test_list_missing_dir(self):
         assert list_checkpoints("/nonexistent/dir") == []
+
+    def test_preempt_sorts_after_boundary_of_same_epoch(self, tmp_path):
+        # a "-preempt" checkpoint holds strictly more steps than the plain
+        # boundary checkpoint of the same epoch, so it must enumerate later —
+        # including for stems that sort lexicographically AFTER "preempt"
+        # (the supervised stem: "epoch=2-preempt…" < "epoch=2-supervised…")
+        for name in (
+            "epoch=2-supervised-cifar10",
+            "epoch=2-supervised-cifar10-preempt",
+            "epoch=1-supervised-cifar10",
+            "epoch=3-supervised-cifar10",
+        ):
+            os.makedirs(tmp_path / name)
+        got = [os.path.basename(p) for p in list_checkpoints(str(tmp_path))]
+        assert got == [
+            "epoch=1-supervised-cifar10",
+            "epoch=2-supervised-cifar10",
+            "epoch=2-supervised-cifar10-preempt",
+            "epoch=3-supervised-cifar10",
+        ]
+        # adversarial stem ordering: preempt tag still wins within the epoch
+        for name in ("epoch=5-a-preempt", "epoch=5-z"):
+            os.makedirs(tmp_path / name)
+        got = [os.path.basename(p) for p in list_checkpoints(str(tmp_path))]
+        assert got[-2:] == ["epoch=5-z", "epoch=5-a-preempt"]
 
 
 class TestRoundTrip:
@@ -228,3 +254,43 @@ class TestIntegrity:
         save_checkpoint(a, _tiny_state(seed=1))
         save_checkpoint(b, _tiny_state(seed=2))
         assert checkpoint_digest(a) != checkpoint_digest(b)
+
+
+class TestRestoreFallback:
+    def test_empty_dir_is_a_fresh_run(self, tmp_path):
+        assert restore_checkpoint_with_fallback(str(tmp_path)) == (None, None)
+
+    def test_newest_verified_wins(self, tmp_path):
+        for e in (1, 2):
+            save_checkpoint(str(tmp_path / f"epoch={e}-m"), _tiny_state(e))
+        restored, path = restore_checkpoint_with_fallback(
+            str(tmp_path), _tiny_state(0)
+        )
+        assert epoch_of(path) == 2
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["dense"]["kernel"]), np.full((4, 2), 2.0)
+        )
+
+    def test_corrupt_newest_falls_back_to_older(self, tmp_path):
+        from simclr_tpu.supervisor.faults import corrupt_checkpoint_bytes
+
+        for e in (1, 2):
+            save_checkpoint(str(tmp_path / f"epoch={e}-m"), _tiny_state(e))
+        corrupt_checkpoint_bytes(str(tmp_path / "epoch=2-m"))
+        restored, path = restore_checkpoint_with_fallback(
+            str(tmp_path), _tiny_state(0)
+        )
+        assert epoch_of(path) == 1
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["dense"]["kernel"]), np.full((4, 2), 1.0)
+        )
+
+    def test_all_corrupt_raises(self, tmp_path):
+        from simclr_tpu.supervisor.faults import corrupt_checkpoint_bytes
+
+        for e in (1, 2):
+            path = str(tmp_path / f"epoch={e}-m")
+            save_checkpoint(path, _tiny_state(e))
+            corrupt_checkpoint_bytes(path)
+        with pytest.raises(CheckpointCorruptionError, match="all 2 checkpoint"):
+            restore_checkpoint_with_fallback(str(tmp_path), _tiny_state(0))

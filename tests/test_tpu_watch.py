@@ -21,6 +21,7 @@ WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "remat2048", "explore1024", "explore512",
+    "supervisor_smoke",
 )
 
 
@@ -69,6 +70,11 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         'case "$*" in *allreduce_bench.py*) '
         'echo \'{"metric": "allreduce_wire_reduction_int8_vs_exact", '
         '"value": 3.98, "unit": "x"}\';; esac',
+        # the supervisor_smoke stage greps its stdout for a clean outcome
+        # with at least one resume (an uncrashed run also exits 0)
+        'case "$*" in *simclr_tpu.supervisor*) '
+        'echo \'{"outcome": "clean", "exit": 0, "attempts": 2, '
+        '"resumed": 1, "restarts": {"crashed": 1}}\';; esac',
         # sleep first: the stage's freshness check compares whole-second
         # mtimes, and consecutive tests touch the same file
         'case "$*" in *bench.py*) sleep 1; touch "$BENCH_CAPTURE_PATH";; esac',
@@ -168,6 +174,20 @@ def test_allreduce_marker_requires_error_free_payload(tmp_path):
     assert "allreduce_bench" not in _done(state)
     assert (state / "allreduce_bench.fails").exists()
     assert "stage allreduce_bench FAILED" in log.read_text()
+
+
+def test_supervisor_marker_requires_an_actual_resume(tmp_path):
+    """The supervisor exiting clean WITHOUT having restarted the child (the
+    injected fault never fired) proves nothing about fault tolerance and
+    must not earn supervisor_smoke.done."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"attempts": 2, "resumed": 1', '"attempts": 1, "resumed": 0'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "supervisor_smoke" not in _done(state)
+    assert (state / "supervisor_smoke.fails").exists()
+    assert "stage supervisor_smoke FAILED" in log.read_text()
 
 
 def test_repeat_offender_is_deferred_not_skipped(tmp_path):

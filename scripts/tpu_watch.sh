@@ -51,7 +51,7 @@ MAX_STAGE_FAILS=3
 # PERF.md's compressed-collectives rows are pending on it), then the
 # remaining step matrices, and last the supervisor kill/resume smoke
 # (fault tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -217,6 +217,22 @@ run_stage() {
             if [ "$rc" -eq 0 ]; then
                 grep -q '"outcome": "clean"' "$out" \
                     && grep -Eq '"resumed": [1-9]' "$out"
+                rc=$?
+            fi ;;
+        obs_smoke)
+            # telemetry e2e ON the chip (scripts/obs_smoke.py): a live
+            # training run is scraped over HTTP until the throughput gauge
+            # goes positive, then SIGTERM'd through the 0/75 contract. rc 0
+            # alone is not enough: the done marker additionally requires the
+            # imgs/s gauge line in the printed /metrics catalog.
+            out="$STATE/obs_smoke.out"
+            rm -rf /tmp/tpu_watch_obs
+            run_locked "$(stage_timeout 1200)" python scripts/obs_smoke.py \
+                --save-dir /tmp/tpu_watch_obs > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -Eq '^simclr_train_imgs_per_sec [0-9.eE+-]+$' "$out"
                 rc=$?
             fi ;;
         bench)

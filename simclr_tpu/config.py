@@ -443,6 +443,38 @@ def _check_runtime_conf(cfg: Config) -> None:
     )
     _check_parallel_conf(cfg)
     _check_supervisor_conf(cfg)
+    _check_telemetry_conf(cfg)
+
+
+def check_telemetry_conf(cfg: Config) -> None:
+    """Validate the ``telemetry.*`` knobs (run observability,
+    docs/OBSERVABILITY.md). Called by both training entry points via
+    :func:`_check_runtime_conf` and by the supervisor runner — like the
+    supervisor knobs, a bad value fails at startup on either side of the
+    process boundary. Deliberately jax-free."""
+    _check_telemetry_conf(cfg)
+
+
+def _check_telemetry_conf(cfg: Config) -> None:
+    port = cfg.select("telemetry.port", 0)
+    _require(
+        isinstance(port, int) and not isinstance(port, bool)
+        and 0 <= port <= 65535,
+        f"telemetry.port must be an int in [0, 65535] (0 = exporter "
+        f"disabled unless telemetry.ready_file is set), got {port!r}",
+    )
+    trace_max_ms = cfg.select("telemetry.trace_max_ms", 60000)
+    _require(
+        isinstance(trace_max_ms, (int, float)) and not isinstance(trace_max_ms, bool)
+        and 0 < trace_max_ms <= 600000,
+        "telemetry.trace_max_ms must be in (0, 600000] milliseconds "
+        f"(cap for POST /debug/trace?ms=N), got {trace_max_ms!r}",
+    )
+    events = cfg.select("telemetry.events", True)
+    _require(
+        isinstance(events, bool),
+        f"telemetry.events must be a boolean (true|false), got {events!r}",
+    )
 
 
 def check_supervisor_conf(cfg: Config) -> None:

@@ -38,6 +38,9 @@ from simclr_tpu.data.cifar import load_dataset
 from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.obs.events import EventLog
+from simclr_tpu.obs.exporter import maybe_start_exporter
+from simclr_tpu.obs.telemetry import Telemetry
 from simclr_tpu.ops.lars import get_weight_decay_mask, lars
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -162,22 +165,55 @@ def run_pretrain(cfg: Config) -> dict:
         state = jax.device_put(state, replicated_sharding(mesh))
 
     save_dir = resolve_save_dir(cfg)
+    # run telemetry (simclr_tpu/obs/, docs/OBSERVABILITY.md): metric
+    # registry + events.jsonl timeline, fed only host floats the loop
+    # already fetches — scraping adds zero device syncs
+    telemetry = Telemetry(
+        arch=str(cfg.experiment.base_cnn),
+        per_device_batch=int(cfg.experiment.batches),
+        global_batch=global_batch,
+        n_devices=jax.device_count(),
+        d=int(cfg.parameter.d),
+        grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+        grad_elements=param_count(state.params),
+        allreduce_devices=n_data,
+    )
+    events = EventLog(
+        save_dir,
+        enabled=bool(cfg.select("telemetry.events", True)) and is_logging_host(),
+    )
     # fault-tolerance guard: preemption checkpointing, heartbeat, non-finite
     # loss rollback (simclr_tpu/supervisor/, docs/FAULT_TOLERANCE.md)
     guard = RunGuard(
         save_dir,
         nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
+        telemetry=telemetry,
+        events=events,
+    )
+    events.emit(
+        "run_start", entry="pretrain", epochs=epochs,
+        steps_per_epoch=steps_per_epoch, global_batch=global_batch,
+        pid=os.getpid(),
     )
     start_epoch = 1
     skip_steps = 0
     if bool(cfg.select("experiment.resume", False)):
         # newest checkpoint whose sha256 sidecar verifies; a corrupt latest
         # falls back to the previous one instead of failing the run
+        t_restore = time.perf_counter()
         restored, ckpt = restore_checkpoint_with_fallback(save_dir, state)
         if restored is not None:
             state = restored
+            telemetry.observe_restore(time.perf_counter() - t_restore)
             start_epoch, skip_steps = resume_point(
                 int(state.step), steps_per_epoch
+            )
+            # re-seat the timeline like pretrain_results.json below: drop
+            # epoch/checkpoint events this run is about to re-emit
+            events.reseat(start_epoch)
+            events.emit(
+                "resume", epoch=start_epoch, step=int(state.step),
+                skip_steps=skip_steps, checkpoint=ckpt,
             )
             logger.info(
                 "Resumed from %s at epoch %d%s", ckpt, start_epoch,
@@ -386,6 +422,7 @@ def run_pretrain(cfg: Config) -> dict:
                 train_X, dataset.labels, val_X, test_ds.labels,
                 dataset.num_classes, top_k=5,
             )
+            telemetry.observe_val_acc(res["val_acc"])
             if is_logging_host():
                 logger.info(
                     "Epoch:%d centroid probe: val top-1 %.4f (top-5 %.4f)",
@@ -417,10 +454,18 @@ def run_pretrain(cfg: Config) -> dict:
         warmup=1 if epoch_compile else 3,
     )
     stem = str(cfg.experiment.output_model_name)
+    # process-0 /metrics + /debug/trace exporter; None unless telemetry.port
+    # (or telemetry.ready_file for an ephemeral port) is configured
+    exporter = (
+        maybe_start_exporter(cfg, telemetry, save_dir)
+        if is_logging_host() else None
+    )
     guard.install_signals()
     try:
         epoch = start_epoch
         while epoch <= epochs:
+            epoch_start_step = cur_step
+            epoch_t0 = time.perf_counter()
             if epoch_compile:
                 idx_e = jnp.asarray(
                     epoch_index_matrix(
@@ -457,28 +502,51 @@ def run_pretrain(cfg: Config) -> dict:
                     save_dir,
                     preempt_checkpoint_name(cur_step, steps_per_epoch, stem),
                 )
+                t_save = time.perf_counter()
                 save_checkpoint(path, state)
+                telemetry.observe_save(time.perf_counter() - t_save)
+                events.emit(
+                    "preempt", step=cur_step, epoch=epoch, checkpoint=path
+                )
                 guard.beat_preempted(cur_step, epoch)
                 raise PreemptedRun(path)
 
             epoch_loss = guard.checked_loss(cur_step, float(metrics["loss"]))
+            if is_logging_host():
+                # epoch telemetry BEFORE the boundary beat, so the beat's
+                # snapshot (and any scrape) reflects the epoch that just
+                # finished; every input is a host float already in hand
+                telemetry.observe_epoch(
+                    epoch,
+                    epochs=epochs,
+                    step=cur_step,
+                    steps=cur_step - epoch_start_step,
+                    seconds=time.perf_counter() - epoch_t0,
+                    loss=epoch_loss,
+                    lr=float(schedule(max(cur_step - 1, 0))),
+                )
             guard.beat(cur_step, epoch, loss=epoch_loss)
             if not math.isfinite(epoch_loss):
                 # roll back to the newest verified checkpoint; a different
                 # RNG stream on the retry — deterministically replaying the
                 # same trajectory would reproduce the same divergence
                 try:
+                    t_restore = time.perf_counter()
                     restored, rpath = restore_checkpoint_with_fallback(
                         save_dir, state
                     )
                 except CheckpointCorruptionError as e:
                     raise PoisonedRun(str(e)) from e
                 guard.record_rollback(epoch_loss, rpath)
+                telemetry.observe_restore(time.perf_counter() - t_restore)
                 state = restored
                 cur_step = int(state.step)
                 epoch, skip_steps = resume_point(cur_step, steps_per_epoch)
                 loss_history = [r for r in loss_history if r[0] < epoch]
                 monitor_history = [r for r in monitor_history if r[0] < epoch]
+                # the rolled-back epochs re-run: re-seat the timeline too so
+                # their epoch/checkpoint events are not duplicated
+                events.reseat(epoch)
                 base_key = jax.random.fold_in(
                     jax.random.key(seed + 1), guard.nan_rollbacks
                 )
@@ -496,6 +564,10 @@ def run_pretrain(cfg: Config) -> dict:
                     imgs_per_sec,
                 )
             loss_history.append([epoch, epoch_loss])
+            events.emit(
+                "epoch", epoch=epoch, step=cur_step, loss=epoch_loss,
+                seconds=round(time.perf_counter() - epoch_t0, 6),
+            )
             if eval_every > 0 and (epoch % eval_every == 0 or epoch == epochs):
                 timer.pause(metrics["loss"])  # keep probe compute out of imgs/sec
                 monitor_val_acc = run_monitor_probe(epoch)
@@ -504,7 +576,10 @@ def run_pretrain(cfg: Config) -> dict:
             if epoch % save_model_epoch == 0 or epoch == epochs:
                 path = os.path.join(save_dir, checkpoint_name(epoch, stem))
                 timer.pause(metrics["loss"])  # keep save I/O out of the imgs/sec window
+                t_save = time.perf_counter()
                 save_checkpoint(path, state)
+                telemetry.observe_save(time.perf_counter() - t_save)
+                events.emit("checkpoint", epoch=epoch, path=path)
                 guard.after_save(epoch, path)
                 timer.resume()
             write_results(
@@ -519,6 +594,8 @@ def run_pretrain(cfg: Config) -> dict:
             epoch += 1
     finally:
         guard.restore_signals()
+        if exporter is not None:
+            exporter.close()
 
     tracer.close(pending=metrics["loss"])
     throughput = timer.summary()
@@ -550,6 +627,7 @@ def run_pretrain(cfg: Config) -> dict:
     if monitor_val_acc is not None:
         summary["monitor_val_acc"] = monitor_val_acc
     write_results(summary)
+    events.emit("run_end", step=int(state.step), loss=summary["final_loss"])
     return summary
 
 

@@ -1,0 +1,45 @@
+"""Run observability: metrics primitives, telemetry registry, event timeline.
+
+One subsystem shared by every tier of the system (docs/OBSERVABILITY.md):
+
+  * :mod:`~simclr_tpu.obs.metrics` — dependency-free Counter/Gauge/Summary/
+    Histogram rendered in the Prometheus text exposition format (promoted
+    out of ``serve/metrics.py``, which re-exports them unchanged);
+  * :mod:`~simclr_tpu.obs.telemetry` — the training-side metric registry
+    (step time, imgs/s, MFU, loss/lr, allreduce wire bytes, checkpoint
+    durations), fed only host-side floats the loop already fetched;
+  * :mod:`~simclr_tpu.obs.events` — structured ``events.jsonl`` timeline in
+    the run dir, shared by the trainers and the supervisor runner;
+  * :mod:`~simclr_tpu.obs.exporter` — process-0 daemon HTTP exporter
+    (``/metrics``, ``/healthz``, ``POST /debug/trace?ms=N``).
+
+``metrics`` and ``events`` are stdlib-only so the supervisor runner and the
+serve tier import them without paying for (or touching) jax; ``telemetry``
+and ``exporter`` defer anything heavier to call time.
+"""
+
+from __future__ import annotations
+
+from simclr_tpu.obs.events import EventLog, events_path, read_events
+from simclr_tpu.obs.metrics import Counter, Gauge, Histogram, Summary
+
+__all__ = [
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Histogram",
+    "Summary",
+    "Telemetry",
+    "events_path",
+    "read_events",
+]
+
+
+def __getattr__(name):
+    # Telemetry imports parallel/compress (jax) — load lazily so stdlib-only
+    # consumers (supervisor runner, serve) keep their import footprint
+    if name == "Telemetry":
+        from simclr_tpu.obs.telemetry import Telemetry
+
+        return Telemetry
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

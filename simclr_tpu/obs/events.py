@@ -1,0 +1,136 @@
+"""Structured run timeline: one ``events.jsonl`` per run directory.
+
+Every notable transition of a (possibly multi-restart) run lands as one
+JSON line — ``run_start``, ``epoch``, ``checkpoint``, ``nan_rollback``,
+``preempt``, ``resume`` from the training process, plus the supervisor
+runner's ``child_exit``/``restart``/``hang``/``outcome`` — so a single file
+reconstructs a kill -9 + auto-resume run end to end without correlating
+logs across attempts.
+
+Design contracts:
+
+  * **atomic appends** — each line is one ``O_APPEND`` write
+    (:func:`simclr_tpu.utils.ioutil.atomic_append`), so the training child
+    and the supervisor parent can interleave writers without tearing lines;
+  * **two clocks** — every event carries wall-clock ``time`` (cross-attempt
+    ordering; attempts are processes with disjoint monotonic clocks) and
+    ``monotonic`` (NTP-step-proof intervals within an attempt);
+  * **attempt tagging** — the supervisor exports its attempt ordinal to the
+    child (``SIMCLR_SUPERVISOR_ATTEMPT``, the same env the ``[attempt N]``
+    log tag reads); the runner passes its own ordinal explicitly;
+  * **resume re-seat** — a resume rewrites the file dropping ``epoch`` and
+    ``checkpoint`` events the restarted run is about to re-emit (epoch >=
+    the resume point), the same discipline as ``pretrain_results.json``.
+    Forensic events (``preempt``, ``nan_rollback``, ``child_exit``) are
+    never dropped — they are what happened, not what will be recomputed.
+
+Stdlib-only by contract: the supervisor runner writes events without
+touching jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from simclr_tpu.utils.ioutil import atomic_append, atomic_write
+
+EVENTS_NAME = "events.jsonl"
+
+# the attempt ordinal env var; duplicated from supervisor/runner.py rather
+# than imported so this module stays importable without the supervisor
+ENV_ATTEMPT = "SIMCLR_SUPERVISOR_ATTEMPT"
+
+# event types a resume re-seat drops at/past the resume epoch: the restarted
+# run deterministically re-runs those epochs and re-emits both
+RESEAT_TYPES = ("epoch", "checkpoint")
+
+
+def events_path(save_dir: str) -> str:
+    """The run's event timeline, fixed relative to ``save_dir`` (like
+    ``heartbeat.json``) so every writer finds it with no channel but argv."""
+    return os.path.join(save_dir, EVENTS_NAME)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse the timeline; skips unparseable lines (a SIGKILL can tear at
+    most the final line — ``O_APPEND`` writes keep whole lines atomic on
+    local filesystems, but the reader stays defensive) and returns ``[]``
+    when the file is absent."""
+    events: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    payload = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(payload, dict):
+                    events.append(payload)
+    except OSError:
+        return []
+    return events
+
+
+class EventLog:
+    """Append-only writer for one run's ``events.jsonl``.
+
+    Constructed per process; ``enabled=False`` (the ``telemetry.events``
+    knob, or a non-logging host) turns every method into a no-op so call
+    sites stay unconditional.
+    """
+
+    def __init__(
+        self,
+        save_dir: str,
+        *,
+        enabled: bool = True,
+        attempt: int | None = None,
+    ):
+        self.path = events_path(save_dir)
+        self.enabled = bool(enabled)
+        if attempt is None:
+            try:
+                attempt = int(os.environ.get(ENV_ATTEMPT, "1"))
+            except ValueError:
+                attempt = 1
+        self.attempt = attempt
+
+    def emit(self, event: str, **fields) -> None:
+        """Append one event line. Explicit ``fields`` win over the defaults,
+        so the supervisor runner can stamp the attempt that just exited
+        rather than its own (always-1) environment."""
+        if not self.enabled:
+            return
+        payload = {
+            "event": event,
+            "time": time.time(),
+            "monotonic": time.monotonic(),
+            "attempt": self.attempt,
+        }
+        payload.update(fields)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        atomic_append(self.path, json.dumps(payload) + "\n")
+
+    def reseat(self, start_epoch: int) -> None:
+        """Drop re-runnable events (:data:`RESEAT_TYPES`) at or past the
+        resume epoch, keeping everything earlier plus all forensic events —
+        the exact analogue of the ``pretrain_results.json`` re-seat, so a
+        resumed run appends without duplicating epoch rows. Unparseable
+        (torn) lines are dropped with the rewrite."""
+        if not self.enabled or not os.path.exists(self.path):
+            return
+        kept = [
+            e
+            for e in read_events(self.path)
+            if not (
+                e.get("event") in RESEAT_TYPES
+                and isinstance(e.get("epoch"), (int, float))
+                and e["epoch"] >= start_epoch
+            )
+        ]
+        atomic_write(
+            self.path,
+            lambda f: f.writelines(json.dumps(e) + "\n" for e in kept),
+        )

@@ -1,0 +1,185 @@
+"""Process-0 telemetry exporter: a daemon HTTP server beside the host loop.
+
+Endpoints (docs/OBSERVABILITY.md):
+
+  * ``GET /metrics``  — the :class:`~simclr_tpu.obs.telemetry.Telemetry`
+    registry in Prometheus text format. Renders only host-side floats the
+    loop already fetched — a scrape can NEVER add a device sync;
+  * ``GET /healthz``  — ``{"status": "ok", ...snapshot}`` liveness JSON;
+  * ``POST /debug/trace?ms=N`` — capture N ms of ``jax.profiler`` trace
+    into ``<save_dir>/trace_on_demand/<stamp>/`` and return its path; the
+    on-call answer to "what is the chip doing RIGHT NOW" without restarting
+    the run with a profile window. Capped by ``telemetry.trace_max_ms``.
+
+Address resolution mirrors the serve tier: ``telemetry.port`` picks a fixed
+port; port 0 with ``telemetry.ready_file`` set binds an ephemeral port and
+publishes ``{"host", "port", "pid"}`` to the ready file; port 0 with no
+ready file means disabled (the default — a training run opens no sockets
+unless asked). Handler threads are daemons so a wedged scraper can never
+block the run's exit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from simclr_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+DEFAULT_TRACE_MS = 1000.0
+TRACE_DIR_NAME = "trace_on_demand"
+
+
+class TelemetryHTTPServer(ThreadingHTTPServer):
+    """Carries the telemetry registry and trace policy for its handlers."""
+
+    daemon_threads = True
+
+    def __init__(self, address, telemetry, save_dir: str, trace_max_ms: float):
+        super().__init__(address, TelemetryHandler)
+        self.telemetry = telemetry
+        self.save_dir = save_dir
+        self.trace_max_ms = float(trace_max_ms)
+        self._trace_seq = 0
+        self._trace_seq_lock = threading.Lock()
+
+    def next_trace_dir(self) -> str:
+        with self._trace_seq_lock:
+            self._trace_seq += 1
+            seq = self._trace_seq
+        return os.path.join(
+            self.save_dir, TRACE_DIR_NAME, f"trace-{int(time.time())}-{seq:03d}"
+        )
+
+
+class TelemetryHandler(BaseHTTPRequestHandler):
+    server: TelemetryHTTPServer
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass  # scrapes every few seconds would flood the training log
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(code, json.dumps(payload).encode(), "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = urlsplit(self.path).path
+        if path == "/metrics":
+            self._send(
+                200,
+                self.server.telemetry.render().encode(),
+                "text/plain; version=0.0.4",
+            )
+        elif path == "/healthz":
+            self._send_json(
+                200, {"status": "ok", **self.server.telemetry.snapshot()}
+            )
+        else:
+            self._send_json(404, {"error": f"unknown path {path!r}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        url = urlsplit(self.path)
+        if url.path != "/debug/trace":
+            self._send_json(404, {"error": f"unknown path {url.path!r}"})
+            return
+        try:
+            ms = float(parse_qs(url.query).get("ms", [DEFAULT_TRACE_MS])[0])
+        except ValueError:
+            self._send_json(400, {"error": "ms must be a number"})
+            return
+        if not 0 < ms <= self.server.trace_max_ms:
+            self._send_json(
+                400,
+                {
+                    "error": f"ms must be in (0, {self.server.trace_max_ms:g}] "
+                    "(telemetry.trace_max_ms)"
+                },
+            )
+            return
+        # jax import deferred to first use: constructing the exporter must
+        # stay cheap and device-free
+        from simclr_tpu.utils.profiling import TraceInProgressError, capture_trace
+
+        trace_dir = self.server.next_trace_dir()
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            capture_trace(trace_dir, ms / 1000.0)
+        except TraceInProgressError as e:
+            self._send_json(409, {"error": str(e)})
+            return
+        self._send_json(200, {"trace_dir": trace_dir, "ms": ms})
+
+
+class TelemetryExporter:
+    """The running exporter: server + daemon accept-loop thread."""
+
+    def __init__(self, server: TelemetryHTTPServer):
+        self.server = server
+        self.host, self.port = server.server_address[:2]
+        self._thread = threading.Thread(
+            target=server.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name="telemetry-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self._thread.join(timeout=5.0)
+        self.server.server_close()
+
+
+def start_exporter(
+    telemetry,
+    save_dir: str,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    ready_file: str | None = None,
+    trace_max_ms: float = 60000.0,
+) -> TelemetryExporter:
+    """Bind, publish the address if asked, and start serving (daemon)."""
+    server = TelemetryHTTPServer((host, int(port)), telemetry, save_dir, trace_max_ms)
+    exporter = TelemetryExporter(server)
+    if ready_file:
+        from simclr_tpu.utils.ioutil import atomic_write
+
+        atomic_write(
+            str(ready_file),
+            lambda f: json.dump(
+                {"host": exporter.host, "port": exporter.port, "pid": os.getpid()},
+                f,
+            ),
+        )
+    logger.info("telemetry exporter on http://%s:%d/metrics", exporter.host, exporter.port)
+    return exporter
+
+
+def maybe_start_exporter(cfg, telemetry, save_dir: str) -> TelemetryExporter | None:
+    """The config-gated entry used by the trainers: ``telemetry.port=0``
+    without a ready file (the default) means no exporter at all."""
+    port = int(cfg.select("telemetry.port", 0) or 0)
+    ready_file = cfg.select("telemetry.ready_file")
+    if port == 0 and not ready_file:
+        return None
+    return start_exporter(
+        telemetry,
+        save_dir,
+        host=str(cfg.select("telemetry.host", "127.0.0.1")),
+        port=port,
+        ready_file=ready_file,
+        trace_max_ms=float(cfg.select("telemetry.trace_max_ms", 60000)),
+    )

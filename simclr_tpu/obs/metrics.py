@@ -1,0 +1,206 @@
+"""Metric primitives rendered in the Prometheus text exposition format.
+
+Minimal, dependency-free instrumentation shared by the serving tier
+(``serve/metrics.py`` re-exports these unchanged) and the training-side
+telemetry registry (``obs/telemetry.py``). Four primitives:
+
+  * :class:`Counter` — monotonically increasing totals (requests, rows,
+    rejections, batches, compile-cache hits/misses, NaN rollbacks);
+  * :class:`Gauge` — point-in-time values, either set explicitly or read
+    from a callback at render time (queue depth);
+  * :class:`Summary` — streaming latency quantiles (p50/p95/p99) over a
+    bounded reservoir of recent observations, plus exact ``_sum``/``_count``;
+  * :class:`Histogram` — fixed cumulative buckets with exact counts, for
+    distributions where a dashboard wants ``histogram_quantile`` over time
+    windows (step time) rather than a process-lifetime reservoir.
+
+Everything is thread-safe: handler threads record, the batcher worker
+records, the training loop records, and ``/metrics`` renders — all
+concurrently. This module is stdlib-only by contract: the supervisor
+runner and the serve tier import it without paying for jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import deque
+from typing import Callable, Sequence
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name} {self.value:g}\n"
+        )
+
+
+class Gauge:
+    """Explicit ``set()`` or a zero-arg callback sampled at render time."""
+
+    def __init__(self, name: str, help_text: str, fn: Callable[[], float] | None = None):
+        self.name = name
+        self.help = help_text
+        self._fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        """Bind a live source sampled at render time (e.g. queue.qsize)."""
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # callback target may be mid-shutdown
+                return 0.0
+        with self._lock:
+            return self._value
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value:g}\n"
+        )
+
+
+class Summary:
+    """Quantiles over a sliding reservoir of the most recent observations.
+
+    ``_sum``/``_count`` are exact over the full history; the p50/p95/p99
+    quantile lines are computed from the last ``reservoir`` observations —
+    recent-window percentiles are what a serving dashboard wants (steady
+    state, not startup-compile transients). Quantiles are linear
+    interpolations over the sorted reservoir, NaN when empty (the
+    Prometheus convention for unobserved summaries).
+    """
+
+    QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help_text: str, reservoir: int = 2048):
+        self.name = name
+        self.help = help_text
+        self._samples: deque[float] = deque(maxlen=reservoir)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(float(value))
+            self._sum += float(value)
+            self._count += 1
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return float("nan")
+        pos = q * (len(data) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(data) - 1)
+        return data[lo] + (data[hi] - data[lo]) * (pos - lo)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} summary",
+        ]
+        for q in self.QUANTILES:
+            lines.append(f'{self.name}{{quantile="{q:g}"}} {self.quantile(q):g}')
+        lines.append(f"{self.name}_sum {self.sum:g}")
+        lines.append(f"{self.name}_count {self.count:g}")
+        return "\n".join(lines) + "\n"
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (the Prometheus ``histogram`` type).
+
+    Where :class:`Summary` answers "what are the recent percentiles", a
+    histogram's exact per-bucket counts let a scraper compute quantiles over
+    ANY time window (``histogram_quantile(rate(..._bucket[5m]))``) and merge
+    across restarts — the right shape for step-time distributions on runs
+    that live for days. Buckets are upper bounds, sorted ascending; an
+    implicit ``+Inf`` bucket catches everything beyond the last bound.
+    """
+
+    def __init__(self, name: str, help_text: str, buckets: Sequence[float]):
+        if not buckets:
+            raise ValueError(f"histogram {name}: at least one bucket bound required")
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # one slot per finite bucket plus the +Inf overflow slot; rendered
+        # cumulatively, stored per-bucket so observe() is a single increment
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        slot = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[slot] += 1
+            self._sum += value
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def render(self) -> str:
+        with self._lock:
+            counts = list(self._counts)
+            total_sum = self._sum
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cumulative = 0
+        for bound, count in zip(self.buckets, counts):
+            cumulative += count
+            lines.append(f'{self.name}_bucket{{le="{bound:g}"}} {cumulative:g}')
+        cumulative += counts[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative:g}')
+        lines.append(f"{self.name}_sum {total_sum:g}")
+        lines.append(f"{self.name}_count {cumulative:g}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,234 @@
+"""Training-side telemetry registry (the ``simclr_train_*`` metric set).
+
+One :class:`Telemetry` per run, wired into ``main.py``/``supervised.py`` and
+scraped by ``obs/exporter.py``. The cardinal design rule (Podracer,
+PAPERS.md: monitoring must cost zero host syncs): every update takes only
+host-side floats the training loop ALREADY fetched through its
+``utils/profiling.synchronize`` value fences — the epoch loss, the schedule
+lr, wall-clock epoch durations. Rendering ``/metrics`` reads those floats
+back; no method here ever touches a ``jax.Array``, so a scrape can never
+add a device round-trip to the hot loop.
+
+MFU reuses the analytic FLOP model from ``scripts/roofline_model.py`` (the
+same math that defended the measured 49% MFU as a ceiling fraction): FLOPs
+per device-step divided by measured step time over the v5e bf16 peak.
+Grad-allreduce wire bytes come from
+:func:`simclr_tpu.parallel.compress.allreduce_wire_bytes` — analytic, per
+device, per step.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from simclr_tpu.obs.metrics import Counter, Gauge, Histogram, Summary
+
+# v5e bf16 peak, mirrored from scripts/roofline_model.py (scripts/ is not a
+# package; the FLOP model itself is file-loaded below so the math has one
+# home, but the peak constant is needed even when scripts/ is absent)
+PEAK_FLOPS = 197e12
+
+# step-time bucket bounds (seconds): 1 ms (CIFAR-small steps on chip) up
+# through minutes (epoch_compile ticks once per epoch)
+STEP_TIME_BUCKETS = (
+    0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def _roofline_flops_per_step(arch: str, per_device_batch: int, d: int) -> float | None:
+    """Total FLOPs of one per-device train step from the roofline model.
+
+    ``scripts/`` is not a package, so the model is loaded by file path
+    relative to the repo root; an installed-without-scripts tree degrades to
+    ``None`` (MFU gauge stays 0) rather than failing the run.
+    """
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "scripts",
+        "roofline_model.py",
+    )
+    try:
+        spec = importlib.util.spec_from_file_location("simclr_roofline", path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return float(
+            sum(op[1] for op in module.model_step(arch, per_device_batch, d=d))
+        )
+    except Exception:
+        return None
+
+
+class Telemetry:
+    """The run's metric registry; see module docstring. Usage::
+
+        telemetry = Telemetry(arch="resnet18", per_device_batch=512, ...)
+        telemetry.observe_epoch(epoch, loss=..., lr=..., steps=..., seconds=...)
+        text = telemetry.render()        # the /metrics payload
+        beat = telemetry.snapshot()      # the heartbeat.json enrichment
+
+    ``flops_per_step`` applies to the PRETRAIN step shape (two views +
+    NT-Xent + LARS); the supervised entry point passes ``arch=None`` so its
+    MFU gauge honestly reads 0 instead of borrowing the wrong model.
+    """
+
+    def __init__(
+        self,
+        *,
+        arch: str | None,
+        per_device_batch: int,
+        global_batch: int,
+        n_devices: int,
+        d: int = 128,
+        grad_allreduce: str = "exact",
+        grad_elements: int | None = None,
+        allreduce_devices: int | None = None,
+        peak_flops: float = PEAK_FLOPS,
+    ):
+        self.global_batch = int(global_batch)
+        self.n_devices = max(int(n_devices), 1)
+        self.peak_flops = float(peak_flops)
+        self.flops_per_step = (
+            _roofline_flops_per_step(arch, per_device_batch, d) if arch else None
+        )
+        self._lock = threading.Lock()
+
+        self.step_time = Histogram(
+            "simclr_train_step_time_seconds",
+            "Mean step wall time, observed once per epoch from the host loop",
+            STEP_TIME_BUCKETS,
+        )
+        self.imgs_per_sec = Gauge(
+            "simclr_train_imgs_per_sec",
+            "Training throughput over the last epoch (dataset images/s)")
+        self.imgs_per_sec_per_chip = Gauge(
+            "simclr_train_imgs_per_sec_per_chip",
+            "Per-device training throughput over the last epoch")
+        self.mfu = Gauge(
+            "simclr_train_mfu",
+            "Model FLOPs utilization vs the bf16 peak, from the roofline "
+            "FLOP model (scripts/roofline_model.py; 0 when no model applies)")
+        self.loss = Gauge(
+            "simclr_train_loss", "Epoch-mean training loss (last epoch)")
+        self.lr = Gauge(
+            "simclr_train_lr", "Learning rate at the last completed step")
+        self.epoch = Gauge(
+            "simclr_train_epoch", "Last completed epoch")
+        self.epochs_total = Gauge(
+            "simclr_train_epochs_total", "Configured total epochs for the run")
+        self.step = Gauge(
+            "simclr_train_step", "Last completed optimizer step")
+        self.val_acc = Gauge(
+            "simclr_train_val_acc",
+            "Latest validation/monitor-probe accuracy (0 until first probe)")
+        self.allreduce_wire_bytes = Gauge(
+            "simclr_train_grad_allreduce_wire_bytes",
+            "Analytic per-device wire bytes of one gradient all-reduce "
+            "(parallel/compress.py)")
+        self.checkpoint_save_seconds = Summary(
+            "simclr_train_checkpoint_save_seconds",
+            "Checkpoint save duration (excluded from throughput windows)")
+        self.checkpoint_restore_seconds = Summary(
+            "simclr_train_checkpoint_restore_seconds",
+            "Checkpoint restore duration (resume and NaN rollback)")
+        self.checkpoint_saves = Counter(
+            "simclr_train_checkpoint_saves_total", "Checkpoints saved")
+        self.nan_rollbacks = Counter(
+            "simclr_train_nan_rollbacks_total",
+            "Non-finite-loss rollbacks booked against the retry budget")
+        self.grad_allreduce_mode = str(grad_allreduce)
+        if grad_elements:
+            from simclr_tpu.parallel.compress import allreduce_wire_bytes
+
+            # the gradient all-reduce spans the DATA axis, not the full mesh
+            self.allreduce_wire_bytes.set(
+                allreduce_wire_bytes(
+                    int(grad_elements),
+                    allreduce_devices or self.n_devices,
+                    self.grad_allreduce_mode,
+                )
+            )
+        self._metrics = (
+            self.step_time, self.imgs_per_sec, self.imgs_per_sec_per_chip,
+            self.mfu, self.loss, self.lr, self.epoch, self.epochs_total,
+            self.step, self.val_acc, self.allreduce_wire_bytes,
+            self.checkpoint_save_seconds, self.checkpoint_restore_seconds,
+            self.checkpoint_saves, self.nan_rollbacks,
+        )
+        self._started = time.time()
+
+    # -- update hooks (host floats only; no device values) -----------------
+    def observe_epoch(
+        self,
+        epoch: int,
+        *,
+        epochs: int,
+        step: int,
+        steps: int,
+        seconds: float,
+        loss: float,
+        lr: float,
+    ) -> None:
+        """Once per completed epoch: ``steps`` host-loop steps took
+        ``seconds`` of wall clock (non-step work like eval/saves excluded by
+        the caller's timer pauses where it matters). Works identically for
+        per-step and ``epoch_compile`` loops — both know the epoch's step
+        count and duration without extra syncs."""
+        self.epoch.set(float(epoch))
+        self.epochs_total.set(float(epochs))
+        self.step.set(float(step))
+        self.loss.set(float(loss))
+        self.lr.set(float(lr))
+        steps = max(int(steps), 1)
+        seconds = max(float(seconds), 1e-9)
+        step_time = seconds / steps
+        self.step_time.observe(step_time)
+        rate = steps * self.global_batch / seconds
+        self.imgs_per_sec.set(rate)
+        self.imgs_per_sec_per_chip.set(rate / self.n_devices)
+        if self.flops_per_step:
+            self.mfu.set(self.flops_per_step / (step_time * self.peak_flops))
+
+    def observe_save(self, seconds: float) -> None:
+        self.checkpoint_save_seconds.observe(float(seconds))
+        self.checkpoint_saves.inc()
+
+    def observe_restore(self, seconds: float) -> None:
+        self.checkpoint_restore_seconds.observe(float(seconds))
+
+    def observe_val_acc(self, acc: float) -> None:
+        self.val_acc.set(float(acc))
+
+    def record_nan_rollback(self) -> None:
+        self.nan_rollbacks.inc()
+
+    # -- read side ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The compact latest-values dict riding on ``heartbeat.json`` (and
+        surfaced by ``supervisor_summary.json``)."""
+        return {
+            "epoch": self.epoch.value,
+            "step": self.step.value,
+            "loss": self.loss.value,
+            "lr": self.lr.value,
+            "imgs_per_sec": self.imgs_per_sec.value,
+            "imgs_per_sec_per_chip": self.imgs_per_sec_per_chip.value,
+            "mfu": self.mfu.value,
+            "uptime_s": round(time.time() - self._started, 3),
+        }
+
+    def render(self) -> str:
+        parts = [m.render() for m in self._metrics]
+        # mode as a labeled constant gauge — the Prometheus idiom for
+        # categorical facts (like build_info)
+        parts.append(
+            "# HELP simclr_train_grad_allreduce_mode Wire format of the "
+            "data-axis gradient all-reduce\n"
+            "# TYPE simclr_train_grad_allreduce_mode gauge\n"
+            f'simclr_train_grad_allreduce_mode{{mode="{self.grad_allreduce_mode}"}} 1\n'
+        )
+        return "".join(parts)

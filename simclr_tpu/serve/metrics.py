@@ -1,144 +1,18 @@
-"""Serving metrics: counters, gauges, and latency summaries.
+"""Serving metrics: the serve tier's metric set over the shared primitives.
 
-Minimal, dependency-free instrumentation rendered in the Prometheus text
-exposition format (``GET /metrics``). Three primitives cover the serving
-surface:
-
-  * :class:`Counter` — monotonically increasing totals (requests, rows,
-    rejections, batches, compile-cache hits/misses);
-  * :class:`Gauge` — point-in-time values, either set explicitly or read
-    from a callback at render time (queue depth);
-  * :class:`Summary` — streaming latency quantiles (p50/p95/p99) over a
-    bounded reservoir of recent observations, plus exact ``_sum``/``_count``.
-
-Everything is thread-safe: handler threads record, the batcher worker
-records, and ``/metrics`` renders — all concurrently.
+The dependency-free Counter/Gauge/Summary primitives were promoted to
+:mod:`simclr_tpu.obs.metrics` so the training-side telemetry registry
+(``obs/telemetry.py``) shares one rendering implementation; they are
+re-exported here unchanged — existing ``from simclr_tpu.serve.metrics
+import Counter`` imports and the serve ``/metrics`` endpoint render
+byte-identically (locked by ``tests/test_obs.py``).
 """
 
 from __future__ import annotations
 
-import threading
-from collections import deque
-from typing import Callable
+from simclr_tpu.obs.metrics import Counter, Gauge, Histogram, Summary
 
-
-class Counter:
-    def __init__(self, name: str, help_text: str):
-        self.name = name
-        self.help = help_text
-        self._value = 0.0
-        self._lock = threading.Lock()
-
-    def inc(self, amount: float = 1.0) -> None:
-        with self._lock:
-            self._value += amount
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-    def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} counter\n"
-            f"{self.name} {self.value:g}\n"
-        )
-
-
-class Gauge:
-    """Explicit ``set()`` or a zero-arg callback sampled at render time."""
-
-    def __init__(self, name: str, help_text: str, fn: Callable[[], float] | None = None):
-        self.name = name
-        self.help = help_text
-        self._fn = fn
-        self._value = 0.0
-        self._lock = threading.Lock()
-
-    def set(self, value: float) -> None:
-        with self._lock:
-            self._value = value
-
-    def set_fn(self, fn: Callable[[], float]) -> None:
-        """Bind a live source sampled at render time (e.g. queue.qsize)."""
-        self._fn = fn
-
-    @property
-    def value(self) -> float:
-        if self._fn is not None:
-            try:
-                return float(self._fn())
-            except Exception:  # callback target may be mid-shutdown
-                return 0.0
-        with self._lock:
-            return self._value
-
-    def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} gauge\n"
-            f"{self.name} {self.value:g}\n"
-        )
-
-
-class Summary:
-    """Quantiles over a sliding reservoir of the most recent observations.
-
-    ``_sum``/``_count`` are exact over the full history; the p50/p95/p99
-    quantile lines are computed from the last ``reservoir`` observations —
-    recent-window percentiles are what a serving dashboard wants (steady
-    state, not startup-compile transients). Quantiles are linear
-    interpolations over the sorted reservoir, NaN when empty (the
-    Prometheus convention for unobserved summaries).
-    """
-
-    QUANTILES = (0.5, 0.95, 0.99)
-
-    def __init__(self, name: str, help_text: str, reservoir: int = 2048):
-        self.name = name
-        self.help = help_text
-        self._samples: deque[float] = deque(maxlen=reservoir)
-        self._sum = 0.0
-        self._count = 0
-        self._lock = threading.Lock()
-
-    def observe(self, value: float) -> None:
-        with self._lock:
-            self._samples.append(float(value))
-            self._sum += float(value)
-            self._count += 1
-
-    def quantile(self, q: float) -> float:
-        with self._lock:
-            data = sorted(self._samples)
-        if not data:
-            return float("nan")
-        pos = q * (len(data) - 1)
-        lo = int(pos)
-        hi = min(lo + 1, len(data) - 1)
-        return data[lo] + (data[hi] - data[lo]) * (pos - lo)
-
-    @property
-    def count(self) -> int:
-        with self._lock:
-            return self._count
-
-    @property
-    def sum(self) -> float:
-        with self._lock:
-            return self._sum
-
-    def render(self) -> str:
-        lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} summary",
-        ]
-        for q in self.QUANTILES:
-            lines.append(f'{self.name}{{quantile="{q:g}"}} {self.quantile(q):g}')
-        lines.append(f"{self.name}_sum {self.sum:g}")
-        lines.append(f"{self.name}_count {self.count:g}")
-        return "\n".join(lines) + "\n"
+__all__ = ["Counter", "Gauge", "Histogram", "ServeMetrics", "Summary"]
 
 
 class ServeMetrics:

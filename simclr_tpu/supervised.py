@@ -33,6 +33,9 @@ from simclr_tpu.data.cifar import NUM_CLASSES, load_dataset
 from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import SupervisedModel
+from simclr_tpu.obs.events import EventLog
+from simclr_tpu.obs.exporter import maybe_start_exporter
+from simclr_tpu.obs.telemetry import Telemetry
 from simclr_tpu.ops.lars import get_weight_decay_mask, lars
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -230,11 +233,34 @@ def run_supervised(cfg: Config) -> dict:
     best_epoch = 0
     start_epoch = 1
     skip_steps = 0
+    # run telemetry + event timeline (simclr_tpu/obs/, docs/OBSERVABILITY.md).
+    # arch=None: the roofline FLOP model covers the pretrain step only, so
+    # the supervised MFU gauge honestly reads 0.
+    telemetry = Telemetry(
+        arch=None,
+        per_device_batch=int(cfg.experiment.batches),
+        global_batch=global_batch,
+        n_devices=jax.device_count(),
+        grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+        grad_elements=param_count(state.params),
+        allreduce_devices=mesh.shape[DATA_AXIS],
+    )
+    events = EventLog(
+        save_dir,
+        enabled=bool(cfg.select("telemetry.events", True)) and is_logging_host(),
+    )
     # fault-tolerance guard: preemption checkpointing, heartbeat, non-finite
     # loss rollback (simclr_tpu/supervisor/, docs/FAULT_TOLERANCE.md)
     guard = RunGuard(
         save_dir,
         nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
+        telemetry=telemetry,
+        events=events,
+    )
+    events.emit(
+        "run_start", entry="supervised", epochs=epochs,
+        steps_per_epoch=steps_per_epoch, global_batch=global_batch,
+        pid=os.getpid(),
     )
     # Resume (VERDICT r3 item 6) — the same restore→start_epoch mechanism as
     # main.py, adapted to the best-only deletion policy: normally the only
@@ -247,8 +273,10 @@ def run_supervised(cfg: Config) -> dict:
     # so the first post-resume epoch can't spuriously "improve" over None and
     # delete the checkpoint it just resumed from.
     if bool(cfg.select("experiment.resume", False)):
+        t_restore = time.perf_counter()
         restored, ckpt = restore_checkpoint_with_fallback(save_dir, state)
         if restored is not None:
+            telemetry.observe_restore(time.perf_counter() - t_restore)
             state = restored
             # best-only invariant restored AFTER the successful restore:
             # drop everything except what we actually resumed from (stale
@@ -260,9 +288,17 @@ def run_supervised(cfg: Config) -> dict:
                 int(state.step), steps_per_epoch
             )
             val_loss, val_acc = run_validation(state)
+            telemetry.observe_val_acc(val_acc)
             best_value = val_loss if metric == "loss" else val_acc
             best_path = ckpt
             best_epoch = start_epoch - 1
+            # the resumed epochs re-run: re-seat the timeline so their
+            # epoch/checkpoint events are not duplicated
+            events.reseat(start_epoch)
+            events.emit(
+                "resume", epoch=start_epoch, step=int(state.step),
+                skip_steps=skip_steps, checkpoint=ckpt,
+            )
             if is_logging_host():
                 logger.info(
                     "Resumed from %s at epoch %d (best %s=%.4f re-validated)",
@@ -296,10 +332,17 @@ def run_supervised(cfg: Config) -> dict:
     # run already completed) must still reach tracer.close/timer.summary
     train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
     stem = f"supervised-{cfg.experiment.name}.pt"
+    # /metrics + /healthz + /debug/trace exporter (process 0 only; disabled
+    # by default — see telemetry.port in conf/supervised_config.yaml)
+    exporter = (
+        maybe_start_exporter(cfg, telemetry, save_dir) if is_logging_host() else None
+    )
     guard.install_signals()
     try:
         epoch = start_epoch
         while epoch <= epochs:
+            epoch_start_step = cur_step
+            epoch_t0 = time.perf_counter()
             if epoch_compile:
                 idx_e = jnp.asarray(
                     epoch_index_matrix(
@@ -340,29 +383,47 @@ def run_supervised(cfg: Config) -> dict:
                     save_dir,
                     preempt_checkpoint_name(cur_step, steps_per_epoch, stem),
                 )
+                t_save = time.perf_counter()
                 save_checkpoint(path, state)
+                telemetry.observe_save(time.perf_counter() - t_save)
+                events.emit("preempt", step=cur_step, epoch=epoch, checkpoint=path)
                 guard.beat_preempted(cur_step, epoch)
                 raise PreemptedRun(path)
 
             epoch_loss = guard.checked_loss(
                 cur_step, float(train_metrics["loss"])
             )
+            # telemetry BEFORE the beat so the heartbeat snapshot is fresh;
+            # host floats only (see obs/telemetry.py) — zero extra syncs
+            if is_logging_host():
+                telemetry.observe_epoch(
+                    epoch, epochs=epochs, step=cur_step,
+                    steps=cur_step - epoch_start_step,
+                    seconds=time.perf_counter() - epoch_t0,
+                    loss=epoch_loss,
+                    lr=float(schedule(max(cur_step - 1, 0))),
+                )
             guard.beat(cur_step, epoch, loss=epoch_loss)
             if not math.isfinite(epoch_loss):
                 # roll back to the newest verified checkpoint; a different
                 # RNG stream on the retry (see main.py)
                 try:
+                    t_restore = time.perf_counter()
                     rolled, rpath = restore_checkpoint_with_fallback(
                         save_dir, state
                     )
                 except CheckpointCorruptionError as e:
                     raise PoisonedRun(str(e)) from e
                 guard.record_rollback(epoch_loss, rpath)
+                telemetry.observe_restore(time.perf_counter() - t_restore)
                 state = rolled
                 cur_step = int(state.step)
                 epoch, skip_steps = resume_point(cur_step, steps_per_epoch)
                 history = [h for h in history if h["epoch"] < epoch]
+                # the rolled-back epochs re-run: re-seat the timeline too
+                events.reseat(epoch)
                 val_loss, val_acc = run_validation(state)
+                telemetry.observe_val_acc(val_acc)
                 best_value = val_loss if metric == "loss" else val_acc
                 best_path = rpath
                 best_epoch = epoch - 1
@@ -373,7 +434,13 @@ def run_supervised(cfg: Config) -> dict:
 
             timer.pause(train_metrics["loss"])  # keep eval out of the imgs/sec window
             val_loss, val_acc = run_validation(state)
+            telemetry.observe_val_acc(val_acc)
             history.append({"epoch": epoch, "val_loss": val_loss, "val_acc": val_acc})
+            events.emit(
+                "epoch", epoch=epoch, step=cur_step, loss=epoch_loss,
+                val_loss=val_loss, val_acc=val_acc,
+                seconds=round(time.perf_counter() - epoch_t0, 6),
+            )
             if is_logging_host():
                 imgs_per_sec = (
                     (cur_step - (start_epoch - 1) * steps_per_epoch)
@@ -400,7 +467,10 @@ def run_supervised(cfg: Config) -> dict:
                 best_value = value
                 best_epoch = epoch
                 best_path = os.path.join(save_dir, checkpoint_name(epoch, stem))
+                t_save = time.perf_counter()
                 save_checkpoint(best_path, state)
+                telemetry.observe_save(time.perf_counter() - t_save)
+                events.emit("checkpoint", epoch=epoch, path=best_path)
                 guard.after_save(epoch, best_path)
                 if prev_best is not None:
                     delete_checkpoint(prev_best)
@@ -408,6 +478,8 @@ def run_supervised(cfg: Config) -> dict:
             epoch += 1
     finally:
         guard.restore_signals()
+        if exporter is not None:
+            exporter.close()
 
     tracer.close(pending=train_metrics["loss"])
     throughput = timer.summary()
@@ -437,6 +509,9 @@ def run_supervised(cfg: Config) -> dict:
             os.path.join(save_dir, "supervised_results.json"),
             lambda f: json.dump(summary, f, indent=1),
         )
+    events.emit(
+        "run_end", step=int(state.step), best_epoch=best_epoch, metric=metric,
+    )
     return summary
 
 

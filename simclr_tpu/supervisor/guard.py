@@ -82,15 +82,30 @@ class RunGuard:
             guard.restore_signals()
     """
 
-    def __init__(self, save_dir: str, *, nan_retry_budget: int = 2):
+    def __init__(
+        self,
+        save_dir: str,
+        *,
+        nan_retry_budget: int = 2,
+        telemetry=None,
+        events=None,
+    ):
         self.save_dir = save_dir
         self.heartbeat_file = heartbeat_path(save_dir)
         self.faults = FaultPlan(save_dir)
         self.nan_retry_budget = int(nan_retry_budget)
         self.nan_rollbacks = 0
+        # optional observability attachments (simclr_tpu/obs/): a Telemetry
+        # registry whose snapshot rides on every beat, and an EventLog for
+        # the structured run timeline — duck-typed, no import needed
+        self.telemetry = telemetry
+        self.events = events
         self._preempt = threading.Event()
         self._previous_handlers: dict[int, object] = {}
         self._beats = is_logging_host()
+
+    def _telemetry_snapshot(self) -> dict | None:
+        return self.telemetry.snapshot() if self.telemetry is not None else None
 
     # -- signals ------------------------------------------------------------
     @property
@@ -136,7 +151,7 @@ class RunGuard:
         if self._beats:
             write_heartbeat(
                 self.heartbeat_file, step=step, epoch=epoch, loss=loss,
-                status=status,
+                status=status, telemetry=self._telemetry_snapshot(),
             )
 
     def beat_preempted(self, step: int, epoch: int) -> None:
@@ -145,7 +160,7 @@ class RunGuard:
         if self._beats:
             write_heartbeat(
                 self.heartbeat_file, step=step, epoch=epoch,
-                status=STATUS_PREEMPTED,
+                status=STATUS_PREEMPTED, telemetry=self._telemetry_snapshot(),
             )
 
     def after_save(self, epoch: int, checkpoint_path: str) -> None:
@@ -164,6 +179,13 @@ class RunGuard:
         :class:`PoisonedRun` when the budget is exhausted or there was no
         verified checkpoint to roll back to (``restored=None``)."""
         self.nan_rollbacks += 1
+        if self.telemetry is not None:
+            self.telemetry.record_nan_rollback()
+        if self.events is not None:
+            self.events.emit(
+                "nan_rollback", loss=loss, checkpoint=restored,
+                retry=self.nan_rollbacks, budget=self.nan_retry_budget,
+            )
         if restored is None:
             raise PoisonedRun(
                 f"loss {loss!r} is non-finite and no verified checkpoint "

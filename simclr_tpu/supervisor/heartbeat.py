@@ -41,8 +41,14 @@ def write_heartbeat(
     epoch: int,
     loss: float | None = None,
     status: str = STATUS_RUNNING,
+    telemetry: dict | None = None,
 ) -> None:
-    """Atomically rewrite the heartbeat (rename, no fsync — see module doc)."""
+    """Atomically rewrite the heartbeat (rename, no fsync — see module doc).
+
+    ``telemetry`` is the latest :meth:`Telemetry.snapshot` dict (host floats
+    only); it rides on the beat so the supervisor — and anyone tailing the
+    file — sees live throughput/MFU/loss without scraping the exporter.
+    """
     payload = {
         "step": int(step),
         "epoch": int(epoch),
@@ -51,6 +57,8 @@ def write_heartbeat(
         "pid": os.getpid(),
         "status": status,
     }
+    if telemetry is not None:
+        payload["telemetry"] = telemetry
     fd, tmp = tempfile.mkstemp(
         dir=os.path.dirname(path) or ".", prefix=os.path.basename(path) + ".tmp."
     )

@@ -35,6 +35,7 @@ import tempfile
 import threading
 import time
 
+from simclr_tpu.obs.events import EventLog
 from simclr_tpu.supervisor.guard import EXIT_POISONED, EXIT_PREEMPTED
 from simclr_tpu.supervisor.heartbeat import heartbeat_path, read_heartbeat
 
@@ -167,6 +168,7 @@ def supervise(
     *,
     resume_args: tuple[str, ...] | list[str] = (),
     env: dict | None = None,
+    events: EventLog | None = None,
 ) -> dict:
     """Run ``cmd`` under supervision until a terminal outcome; returns the
     summary dict (also written to ``<save_dir>/supervisor_summary.json``).
@@ -174,9 +176,17 @@ def supervise(
     ``resume_args`` are appended to the command on every attempt AFTER the
     first — the entry points apply overrides in order, so a trailing
     ``experiment.resume=true`` wins whatever the caller passed.
+
+    ``events`` (an :class:`~simclr_tpu.obs.events.EventLog` on the SAME
+    ``save_dir``) records the supervisor side of the run timeline —
+    child exits, hangs, backed-off restarts, the terminal outcome — into
+    the child's own ``events.jsonl``, each stamped with the attempt it
+    describes.
     """
     os.makedirs(save_dir, exist_ok=True)
     hb_path = heartbeat_path(save_dir)
+    if events is None:
+        events = EventLog(save_dir, enabled=False)
     # poll fast enough to resolve the configured minimum timeout
     poll_s = min(0.5, max(0.05, knobs.heartbeat_min_timeout_s / 4.0))
 
@@ -213,6 +223,15 @@ def supervise(
             "save_dir": save_dir,
             "wall_time_s": round(time.monotonic() - t0, 3),
         }
+        # surface the child's last telemetry snapshot (riding on its final
+        # heartbeat) so one file answers "how fast was it going when it ended"
+        beat = read_heartbeat(hb_path)
+        if beat is not None and isinstance(beat.get("telemetry"), dict):
+            summary["telemetry"] = beat["telemetry"]
+        events.emit(
+            "outcome", outcome=outcome, exit=exit_code, attempt=attempt,
+            resumed=attempt - 1,
+        )
         _write_summary(save_dir, summary)
         return summary
 
@@ -238,11 +257,13 @@ def supervise(
                     # wedged: no beat within the adaptive window. SIGKILL —
                     # a hung SPMD program won't honor anything gentler
                     hung = True
+                    events.emit("hang", attempt=attempt)
                     proc.kill()
                     rc = proc.wait()
                     break
             child["proc"] = None
             last_rc = rc
+            events.emit("child_exit", attempt=attempt, exit=rc, hung=hung)
 
             if not hung and rc == 0:
                 return _summary(OUTCOME_CLEAN, 0)
@@ -265,6 +286,11 @@ def supervise(
                 return _summary(OUTCOME_CRASHED, exit_code)
             restarts[kind] += 1
             backoff = knobs.backoff_base_s * (2.0 ** total)
+            events.emit(
+                "restart", attempt=attempt, kind=kind, exit=rc,
+                backoff_s=backoff, restart=total + 1,
+                max_restarts=knobs.max_restarts,
+            )
             print(
                 f"supervisor: child {kind} (exit {rc}); restart "
                 f"{total + 1}/{knobs.max_restarts} in {backoff:.1f}s",
@@ -289,6 +315,7 @@ def main(argv: list[str] | None = None) -> int:
     from simclr_tpu.config import (
         ConfigError,
         check_supervisor_conf,
+        check_telemetry_conf,
         load_config,
         resolve_save_dir,
     )
@@ -317,6 +344,7 @@ def main(argv: list[str] | None = None) -> int:
     try:
         cfg = load_config(config_name, overrides=overrides)
         check_supervisor_conf(cfg)
+        check_telemetry_conf(cfg)
         knobs = SupervisorKnobs.from_config(cfg)
         save_dir = resolve_save_dir(cfg)
     except ConfigError as e:
@@ -329,7 +357,10 @@ def main(argv: list[str] | None = None) -> int:
 
     cmd = [sys.executable, "-m", module, *overrides]
     summary = supervise(
-        cmd, save_dir, knobs, resume_args=("experiment.resume=true",)
+        cmd, save_dir, knobs, resume_args=("experiment.resume=true",),
+        events=EventLog(
+            save_dir, enabled=bool(cfg.select("telemetry.events", True))
+        ),
     )
     print(json.dumps(summary), flush=True)
     return int(summary["exit"])

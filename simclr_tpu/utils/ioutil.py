@@ -25,6 +25,24 @@ _UMASK = os.umask(0)
 os.umask(_UMASK)
 
 
+def atomic_append(path: str, text: str) -> None:
+    """Append ``text`` to ``path`` as ONE ``write(2)`` on an ``O_APPEND`` fd.
+
+    POSIX makes the seek-to-end and the write atomic together, so concurrent
+    appenders (the training child and the supervisor runner both write
+    ``events.jsonl``) interleave whole records, never torn ones — provided
+    each record is a single write, which is why this takes the full string
+    rather than a file object. No fsync: timeline events are forensics, not
+    resume gates (same trade as ``supervisor/heartbeat.py``).
+    """
+    data = text.encode()
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o666 & ~_UMASK)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
 def atomic_write(path: str, write_fn: Callable[[IO], None], mode: str = "w") -> None:
     """Write via ``write_fn(file)`` to a unique temp file, then rename.
 

@@ -13,6 +13,7 @@ builds live or die by the profile, so this module provides:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 import jax
@@ -58,6 +59,38 @@ def trace(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class TraceInProgressError(RuntimeError):
+    """A profiler capture is already running (jax allows one at a time)."""
+
+
+# serializes on-demand captures (obs/exporter.py handler threads); a capture
+# racing a StepTraceWindow still fails inside jax, reported as this error
+_capture_lock = threading.Lock()
+
+
+def capture_trace(log_dir: str, seconds: float) -> None:
+    """Blocking on-demand profiler capture of the NEXT ``seconds`` of device
+    activity into ``log_dir`` (the ``POST /debug/trace?ms=N`` backend).
+
+    The capture rides alongside the training loop without touching it: the
+    profiler observes whatever the devices are doing, so this adds no sync
+    to the loop — only the exporter's handler thread sleeps.
+    """
+    if not _capture_lock.acquire(blocking=False):
+        raise TraceInProgressError("a profiler capture is already in progress")
+    try:
+        try:
+            jax.profiler.start_trace(str(log_dir))
+        except Exception as e:  # e.g. a StepTraceWindow already tracing
+            raise TraceInProgressError(str(e)) from e
+        try:
+            time.sleep(max(float(seconds), 0.0))
+        finally:
+            jax.profiler.stop_trace()
+    finally:
+        _capture_lock.release()
 
 
 class StepTraceWindow:

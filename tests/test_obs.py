@@ -1,0 +1,637 @@
+"""Observability suite (simclr_tpu/obs/, docs/OBSERVABILITY.md).
+
+Covers the four tentpole layers plus their contracts:
+
+* metric primitives — the new fixed-bucket :class:`Histogram` and the
+  serve-tier back-compat shim: ``serve/metrics.py`` must re-export the SAME
+  primitive classes and render ``/metrics`` byte-identically to the
+  pre-refactor implementation (golden generated from that implementation);
+* the :class:`Telemetry` registry — throughput/MFU/wire-bytes math against
+  the roofline and compress models it reuses, snapshot shape;
+* the ``events.jsonl`` timeline — atomic appends, attempt tagging, torn-line
+  tolerance, and the resume re-seat discipline;
+* the HTTP exporter — scrape/healthz/trace endpoints, port semantics;
+* config validation ranges for the ``telemetry.*`` knobs;
+* slow e2e proofs — a mid-run scrape adds ZERO ``synchronize`` calls to the
+  training loop, and an injected hard crash under the supervisor yields ONE
+  merged two-attempt timeline with no duplicated epoch events.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import simclr_tpu
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(simclr_tpu.__file__)))
+
+from simclr_tpu.obs import metrics as obs_metrics
+from simclr_tpu.obs.events import (
+    ENV_ATTEMPT,
+    EventLog,
+    events_path,
+    read_events,
+)
+from simclr_tpu.obs.exporter import maybe_start_exporter, start_exporter
+from simclr_tpu.obs.metrics import Histogram
+from simclr_tpu.utils.ioutil import atomic_append
+
+pytestmark = pytest.mark.obs
+
+
+# ---------------------------------------------------------------------------
+# serve-tier back-compat shim
+# ---------------------------------------------------------------------------
+
+# Golden /metrics render generated from the PRE-refactor serve/metrics.py
+# (primitives still private to the serve tier) with the exact feed sequence
+# of _feed_serve_metrics below. The shim must reproduce it byte for byte.
+SERVE_GOLDEN = """\
+# HELP simclr_serve_requests_total Embed requests accepted into the queue
+# TYPE simclr_serve_requests_total counter
+simclr_serve_requests_total 7
+# HELP simclr_serve_rows_total Image rows accepted into the queue
+# TYPE simclr_serve_rows_total counter
+simclr_serve_rows_total 200
+# HELP simclr_serve_rejected_total Embed requests rejected with backpressure (queue full)
+# TYPE simclr_serve_rejected_total counter
+simclr_serve_rejected_total 1
+# HELP simclr_serve_failed_total Embed requests that failed in the engine
+# TYPE simclr_serve_failed_total counter
+simclr_serve_failed_total 0
+# HELP simclr_serve_batches_total Engine batches dispatched
+# TYPE simclr_serve_batches_total counter
+simclr_serve_batches_total 4
+# HELP simclr_serve_batch_requests_total Requests coalesced into dispatched batches
+# TYPE simclr_serve_batch_requests_total counter
+simclr_serve_batch_requests_total 10
+# HELP simclr_serve_batch_rows_total Rows across dispatched batches
+# TYPE simclr_serve_batch_rows_total counter
+simclr_serve_batch_rows_total 180
+# HELP simclr_serve_batch_capacity_total Padded bucket capacity across dispatched batches (rows)
+# TYPE simclr_serve_batch_capacity_total counter
+simclr_serve_batch_capacity_total 256
+# HELP simclr_serve_compile_cache_hits_total Engine batches whose bucket was already warm (no compile)
+# TYPE simclr_serve_compile_cache_hits_total counter
+simclr_serve_compile_cache_hits_total 3
+# HELP simclr_serve_compile_cache_misses_total Engine batches that compiled a cold bucket
+# TYPE simclr_serve_compile_cache_misses_total counter
+simclr_serve_compile_cache_misses_total 1
+# HELP simclr_serve_queue_depth Requests waiting in the batcher queue
+# TYPE simclr_serve_queue_depth gauge
+simclr_serve_queue_depth 2
+# HELP simclr_serve_request_latency_ms Submit-to-result latency per request (milliseconds)
+# TYPE simclr_serve_request_latency_ms summary
+simclr_serve_request_latency_ms{quantile="0.5"} 2.5
+simclr_serve_request_latency_ms{quantile="0.95"} 9.25
+simclr_serve_request_latency_ms{quantile="0.99"} 9.85
+simclr_serve_request_latency_ms_sum 14
+simclr_serve_request_latency_ms_count 3
+# HELP simclr_serve_batch_latency_ms Engine forward latency per dispatched batch (milliseconds)
+# TYPE simclr_serve_batch_latency_ms summary
+simclr_serve_batch_latency_ms{quantile="0.5"} 4.25
+simclr_serve_batch_latency_ms{quantile="0.95"} 4.25
+simclr_serve_batch_latency_ms{quantile="0.99"} 4.25
+simclr_serve_batch_latency_ms_sum 4.25
+simclr_serve_batch_latency_ms_count 1
+# HELP simclr_serve_avg_batch_fill Mean requests per dispatched batch
+# TYPE simclr_serve_avg_batch_fill gauge
+simclr_serve_avg_batch_fill 2.5
+# HELP simclr_serve_batch_fill_ratio Mean rows over padded bucket capacity
+# TYPE simclr_serve_batch_fill_ratio gauge
+simclr_serve_batch_fill_ratio 0.703125
+"""
+
+
+def _feed_serve_metrics(m):
+    m.requests_total.inc(7)
+    m.rows_total.inc(200)
+    m.rejected_total.inc()
+    m.batches_total.inc(4)
+    m.batch_requests_total.inc(10)
+    m.batch_rows_total.inc(180)
+    m.batch_capacity_total.inc(256)
+    m.compile_cache_hits_total.inc(3)
+    m.compile_cache_misses_total.inc(1)
+    m.queue_depth.set(2)
+    for v in (1.5, 2.5, 10.0):
+        m.request_latency_ms.observe(v)
+    m.batch_latency_ms.observe(4.25)
+
+
+class TestServeShim:
+    def test_primitives_are_the_same_classes(self):
+        from simclr_tpu.serve import metrics as serve_metrics
+
+        assert serve_metrics.Counter is obs_metrics.Counter
+        assert serve_metrics.Gauge is obs_metrics.Gauge
+        assert serve_metrics.Summary is obs_metrics.Summary
+        assert serve_metrics.Histogram is obs_metrics.Histogram
+
+    def test_serve_render_is_byte_identical_to_pre_refactor(self):
+        from simclr_tpu.serve.metrics import ServeMetrics
+
+        m = ServeMetrics()
+        _feed_serve_metrics(m)
+        assert m.render() == SERVE_GOLDEN
+
+
+# ---------------------------------------------------------------------------
+# Histogram primitive
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_cumulative_buckets_and_inf(self):
+        h = Histogram("t_seconds", "help", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 50.0):
+            h.observe(v)
+        text = h.render()
+        assert 't_seconds_bucket{le="0.1"} 1' in text
+        assert 't_seconds_bucket{le="1"} 2' in text
+        assert 't_seconds_bucket{le="10"} 2' in text
+        assert 't_seconds_bucket{le="+Inf"} 3' in text
+        assert "t_seconds_sum 50.55" in text
+        assert "t_seconds_count 3" in text
+        assert h.count == 3 and h.sum == pytest.approx(50.55)
+
+    def test_le_is_inclusive(self):
+        # Prometheus le semantics: a value equal to a bound counts in it
+        h = Histogram("t", "help", buckets=(1.0, 2.0))
+        h.observe(1.0)
+        assert 't_bucket{le="1"} 1' in h.render()
+
+    def test_empty_histogram_renders_zeros(self):
+        h = Histogram("t", "help", buckets=(1.0,))
+        text = h.render()
+        assert 't_bucket{le="1"} 0' in text
+        assert 't_bucket{le="+Inf"} 0' in text
+        assert "t_count 0" in text
+
+    def test_unsorted_bounds_are_sorted(self):
+        h = Histogram("t", "help", buckets=(5.0, 1.0))
+        assert h.buckets == (1.0, 5.0)
+
+    def test_no_buckets_rejected(self):
+        with pytest.raises(ValueError, match="bucket"):
+            Histogram("t", "help", buckets=())
+
+
+# ---------------------------------------------------------------------------
+# Telemetry registry
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetry:
+    def _make(self, **kw):
+        from simclr_tpu.obs.telemetry import Telemetry
+
+        base = dict(
+            arch="resnet18", per_device_batch=8, global_batch=64, n_devices=8
+        )
+        base.update(kw)
+        return Telemetry(**base)
+
+    def test_flops_match_roofline_model(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "roofline", os.path.join(REPO_ROOT, "scripts", "roofline_model.py")
+        )
+        roofline = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(roofline)
+        expected = sum(op[1] for op in roofline.model_step("resnet18", 8, d=128))
+        assert self._make().flops_per_step == pytest.approx(expected)
+
+    def test_observe_epoch_sets_rates_and_mfu(self):
+        t = self._make()
+        t.observe_epoch(
+            3, epochs=10, step=6, steps=2, seconds=4.0, loss=1.5, lr=0.1
+        )
+        assert t.epoch.value == 3 and t.step.value == 6
+        assert t.loss.value == 1.5 and t.lr.value == pytest.approx(0.1)
+        assert t.imgs_per_sec.value == pytest.approx(2 * 64 / 4.0)
+        assert t.imgs_per_sec_per_chip.value == pytest.approx(2 * 64 / 4.0 / 8)
+        # step_time = 2.0s; MFU = flops / (step_time * peak)
+        assert t.mfu.value == pytest.approx(
+            t.flops_per_step / (2.0 * t.peak_flops)
+        )
+        assert t.step_time.count == 1
+
+    def test_no_arch_means_honest_zero_mfu(self):
+        t = self._make(arch=None)
+        assert t.flops_per_step is None
+        t.observe_epoch(1, epochs=2, step=2, steps=2, seconds=1.0, loss=1.0, lr=0.1)
+        assert t.mfu.value == 0.0
+        assert t.imgs_per_sec.value > 0  # throughput still reported
+
+    def test_unknown_arch_degrades_to_none(self):
+        assert self._make(arch="not-a-model").flops_per_step is None
+
+    def test_wire_bytes_match_compress_model(self):
+        from simclr_tpu.parallel.compress import allreduce_wire_bytes
+
+        t = self._make(
+            grad_allreduce="int8", grad_elements=11_000_000, allreduce_devices=4
+        )
+        assert t.allreduce_wire_bytes.value == pytest.approx(
+            allreduce_wire_bytes(11_000_000, 4, "int8")
+        )
+        assert (
+            'simclr_train_grad_allreduce_mode{mode="int8"} 1' in t.render()
+        )
+
+    def test_snapshot_shape(self):
+        t = self._make()
+        t.observe_epoch(1, epochs=2, step=2, steps=2, seconds=1.0, loss=2.5, lr=0.3)
+        snap = t.snapshot()
+        assert set(snap) == {
+            "epoch", "step", "loss", "lr", "imgs_per_sec",
+            "imgs_per_sec_per_chip", "mfu", "uptime_s",
+        }
+        assert snap["loss"] == 2.5
+        assert json.loads(json.dumps(snap)) == snap  # heartbeat-serializable
+
+    def test_checkpoint_and_rollback_counters(self):
+        t = self._make()
+        t.observe_save(1.25)
+        t.observe_restore(0.5)
+        t.record_nan_rollback()
+        assert t.checkpoint_saves.value == 1
+        assert t.checkpoint_save_seconds.count == 1
+        assert t.checkpoint_restore_seconds.sum == pytest.approx(0.5)
+        assert t.nan_rollbacks.value == 1
+
+
+# ---------------------------------------------------------------------------
+# events.jsonl timeline
+# ---------------------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_emit_read_roundtrip(self, tmp_path):
+        log = EventLog(str(tmp_path))
+        log.emit("run_start", epochs=3)
+        log.emit("epoch", epoch=1, loss=2.5)
+        events = read_events(events_path(str(tmp_path)))
+        assert [e["event"] for e in events] == ["run_start", "epoch"]
+        assert events[1]["epoch"] == 1 and events[1]["loss"] == 2.5
+        for e in events:
+            assert "time" in e and "monotonic" in e and e["attempt"] == 1
+
+    def test_attempt_from_supervisor_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ENV_ATTEMPT, "3")
+        log = EventLog(str(tmp_path))
+        log.emit("resume", epoch=2)
+        assert read_events(log.path)[0]["attempt"] == 3
+
+    def test_explicit_fields_override_defaults(self, tmp_path):
+        # the supervisor runner stamps the attempt that just exited, not its
+        # own (always-1) environment
+        log = EventLog(str(tmp_path))
+        log.emit("child_exit", attempt=4, exit=77)
+        assert read_events(log.path)[0]["attempt"] == 4
+
+    def test_disabled_log_is_a_noop(self, tmp_path):
+        log = EventLog(str(tmp_path), enabled=False)
+        log.emit("run_start")
+        log.reseat(1)
+        assert not os.path.exists(log.path)
+
+    def test_reseat_drops_only_rerunnable_events(self, tmp_path):
+        log = EventLog(str(tmp_path))
+        log.emit("run_start", epochs=3)
+        log.emit("epoch", epoch=1)
+        log.emit("checkpoint", epoch=1)
+        log.emit("epoch", epoch=2)
+        log.emit("checkpoint", epoch=2)
+        log.emit("nan_rollback", epoch=2)  # forensic: must survive
+        log.emit("preempt", epoch=2, step=3)  # forensic: must survive
+        log.reseat(2)
+        kinds = [(e["event"], e.get("epoch")) for e in read_events(log.path)]
+        assert kinds == [
+            ("run_start", None), ("epoch", 1), ("checkpoint", 1),
+            ("nan_rollback", 2), ("preempt", 2),
+        ]
+
+    def test_torn_final_line_is_skipped_and_dropped_by_reseat(self, tmp_path):
+        log = EventLog(str(tmp_path))
+        log.emit("epoch", epoch=1)
+        with open(log.path, "a") as f:
+            f.write('{"event": "epoch", "epo')  # SIGKILL mid-write
+        assert [e["epoch"] for e in read_events(log.path)] == [1]
+        log.reseat(5)  # keeps epoch 1, rewrites without the torn tail
+        lines = open(log.path).read().splitlines()
+        assert len(lines) == 1 and json.loads(lines[0])["epoch"] == 1
+
+    def test_read_missing_file_is_empty(self, tmp_path):
+        assert read_events(str(tmp_path / "nope.jsonl")) == []
+
+    def test_atomic_append_creates_and_appends(self, tmp_path):
+        path = str(tmp_path / "x.log")
+        atomic_append(path, "a\n")
+        atomic_append(path, "b\n")
+        assert open(path).read() == "a\nb\n"
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter
+# ---------------------------------------------------------------------------
+
+
+class _StubTelemetry:
+    """render()/snapshot() duck type — exporter tests need no jax."""
+
+    def render(self):
+        return "# HELP x y\n# TYPE x gauge\nx 1\n"
+
+    def snapshot(self):
+        return {"epoch": 7.0, "imgs_per_sec": 123.0}
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read().decode()
+
+
+def _post(url, timeout=60):
+    req = urllib.request.Request(url, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+@pytest.fixture
+def exporter(tmp_path):
+    exp = start_exporter(
+        _StubTelemetry(), str(tmp_path), trace_max_ms=5000,
+        ready_file=str(tmp_path / "ready.json"),
+    )
+    yield exp
+    exp.close()
+
+
+class TestExporter:
+    def test_ready_file_publishes_ephemeral_port(self, exporter, tmp_path):
+        info = json.load(open(tmp_path / "ready.json"))
+        assert info == {
+            "host": "127.0.0.1", "port": exporter.port, "pid": os.getpid()
+        }
+        assert exporter.port > 0
+
+    def test_metrics_scrape(self, exporter):
+        status, ctype, body = _get(
+            f"http://127.0.0.1:{exporter.port}/metrics"
+        )
+        assert status == 200
+        assert ctype == "text/plain; version=0.0.4"
+        assert body == _StubTelemetry().render()
+
+    def test_healthz_carries_snapshot(self, exporter):
+        status, _, body = _get(f"http://127.0.0.1:{exporter.port}/healthz")
+        assert status == 200
+        assert json.loads(body) == {
+            "status": "ok", "epoch": 7.0, "imgs_per_sec": 123.0
+        }
+
+    def test_unknown_paths_404(self, exporter):
+        for method in (_get, _post):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                method(f"http://127.0.0.1:{exporter.port}/nope")
+            assert err.value.code == 404
+
+    def test_trace_ms_validation(self, exporter):
+        base = f"http://127.0.0.1:{exporter.port}/debug/trace"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base + "?ms=banana")
+        assert err.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base + "?ms=999999")  # over trace_max_ms=5000
+        assert err.value.code == 400
+        assert "trace_max_ms" in err.value.read().decode()
+
+    def test_trace_capture_writes_nonempty_dir(self, exporter, tmp_path):
+        import jax.numpy as jnp
+
+        jnp.ones(8).sum().block_until_ready()  # device warm before tracing
+        status, payload = _post(
+            f"http://127.0.0.1:{exporter.port}/debug/trace?ms=50"
+        )
+        assert status == 200 and payload["ms"] == 50
+        trace_dir = payload["trace_dir"]
+        assert trace_dir.startswith(str(tmp_path))
+        assert os.listdir(trace_dir), "trace capture left an empty directory"
+
+    def test_maybe_start_exporter_port_semantics(self, tmp_path):
+        from simclr_tpu.config import load_config
+
+        # default: port 0, no ready file -> disabled, no socket
+        cfg = load_config("config")
+        assert maybe_start_exporter(cfg, _StubTelemetry(), str(tmp_path)) is None
+        # port 0 + ready_file -> ephemeral port, published
+        ready = tmp_path / "r.json"
+        cfg = load_config(
+            "config", overrides=[f"telemetry.ready_file={ready}"]
+        )
+        exp = maybe_start_exporter(cfg, _StubTelemetry(), str(tmp_path))
+        try:
+            assert exp is not None
+            assert json.load(open(ready))["port"] == exp.port
+        finally:
+            exp.close()
+
+
+class TestConfigValidation:
+    def test_defaults_validate(self):
+        from simclr_tpu.config import check_telemetry_conf, load_config
+
+        check_telemetry_conf(load_config("config"))
+        check_telemetry_conf(load_config("supervised_config"))
+
+    @pytest.mark.parametrize(
+        "override, expected_range",
+        [
+            ("telemetry.port=-1", "[0, 65535]"),
+            ("telemetry.port=65536", "[0, 65535]"),
+            ("telemetry.trace_max_ms=0", "(0, 600000]"),
+            ("telemetry.trace_max_ms=900000", "(0, 600000]"),
+            ("telemetry.events=maybe", "(true|false)"),
+        ],
+    )
+    def test_bad_knobs_name_the_valid_range(self, override, expected_range):
+        from simclr_tpu.config import ConfigError, check_telemetry_conf, load_config
+
+        cfg = load_config("config", overrides=[override])
+        with pytest.raises(ConfigError, match="telemetry\\.") as err:
+            check_telemetry_conf(cfg)
+        assert expected_range in str(err.value)
+
+    def test_both_entry_point_checks_cover_telemetry(self):
+        from simclr_tpu.config import (
+            ConfigError,
+            check_pretrain_conf,
+            check_supervised_conf,
+            load_config,
+        )
+
+        bad = ["telemetry.port=-1"]
+        with pytest.raises(ConfigError, match="telemetry.port"):
+            check_pretrain_conf(load_config("config", overrides=bad))
+        with pytest.raises(ConfigError, match="telemetry.port"):
+            check_supervised_conf(
+                load_config("supervised_config", overrides=bad)
+            )
+
+
+# ---------------------------------------------------------------------------
+# e2e proofs (slow: real training runs on the 8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+SYNTH = [
+    "experiment.synthetic_data=true",
+    "experiment.synthetic_size=64",
+    "experiment.batches=4",  # x8 devices = global batch 32 -> 2 steps/epoch
+    "parameter.warmup_epochs=1",
+    "experiment.save_model_epoch=1",
+]
+
+
+def _run_pretrain_counting_syncs(overrides, monkeypatch, scrape=None):
+    """Run a tiny in-process pretrain with ``utils.profiling.synchronize``
+    wrapped by a counter; optionally run ``scrape(ready_path)`` concurrently
+    from this thread while training runs in a worker thread. Returns
+    (summary, sync_count)."""
+    from simclr_tpu.config import load_config
+    from simclr_tpu.main import run_pretrain
+    from simclr_tpu.utils import profiling
+
+    counts = [0]
+    real_sync = profiling.synchronize
+
+    def counting_sync(tree):
+        counts[0] += 1
+        return real_sync(tree)
+
+    monkeypatch.setattr(profiling, "synchronize", counting_sync)
+    cfg = load_config("config", overrides=overrides)
+    result = {}
+    if scrape is None:
+        result["summary"] = run_pretrain(cfg)
+    else:
+        worker = threading.Thread(
+            target=lambda: result.update(summary=run_pretrain(cfg))
+        )
+        worker.start()
+        scrape(worker)
+        worker.join(timeout=900)
+        assert not worker.is_alive(), "training thread did not finish"
+    monkeypatch.setattr(profiling, "synchronize", real_sync)
+    return result["summary"], counts[0]
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    def test_scrape_adds_zero_syncs_and_writes_timeline(
+        self, tmp_path, monkeypatch
+    ):
+        """Acceptance proof for the zero-sync contract: the same 2-epoch run
+        with the exporter enabled and /metrics scraped continuously performs
+        EXACTLY as many ``synchronize`` device fences as the run with no
+        exporter at all. (Sync points are fixed loop landmarks, so the count
+        is deterministic per config.)"""
+        base = SYNTH + ["parameter.epochs=2"]
+        _, baseline_syncs = _run_pretrain_counting_syncs(
+            base + [f"experiment.save_dir={tmp_path / 'plain'}"], monkeypatch
+        )
+
+        obs_dir = tmp_path / "observed"
+        ready = obs_dir / "ready.json"
+        scrapes = [0]
+
+        def scrape(worker):
+            deadline = time.monotonic() + 600
+            port = None
+            while time.monotonic() < deadline and worker.is_alive():
+                if port is None:
+                    try:
+                        port = json.load(open(ready))["port"]
+                    except (OSError, ValueError, KeyError):
+                        time.sleep(0.2)
+                        continue
+                try:
+                    _, _, body = _get(f"http://127.0.0.1:{port}/metrics")
+                    _get(f"http://127.0.0.1:{port}/healthz")
+                    assert "simclr_train_imgs_per_sec" in body
+                    scrapes[0] += 1
+                except (urllib.error.URLError, OSError):
+                    pass  # exporter already closed at run end
+                time.sleep(0.1)
+
+        summary, observed_syncs = _run_pretrain_counting_syncs(
+            base + [
+                f"experiment.save_dir={obs_dir}",
+                f"telemetry.ready_file={ready}",
+            ],
+            monkeypatch,
+            scrape=scrape,
+        )
+        assert scrapes[0] > 0, "no scrape actually landed mid-run"
+        assert observed_syncs == baseline_syncs
+        assert summary["complete"] is True
+
+        # the same run also wrote a coherent single-attempt timeline
+        events = read_events(events_path(str(obs_dir)))
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+        assert [e["epoch"] for e in events if e["event"] == "epoch"] == [1, 2]
+        assert "checkpoint" in kinds
+        assert {e["attempt"] for e in events} == {1}
+
+    def test_injected_crash_yields_merged_two_attempt_timeline(self, tmp_path):
+        """Acceptance proof: hard-kill + auto-resume under the supervisor
+        leaves ONE events.jsonl telling the whole story — both attempts, in
+        order, each epoch exactly once, the supervisor's own child_exit /
+        restart / outcome events interleaved, and the final telemetry
+        snapshot surfaced in supervisor_summary.json."""
+        from simclr_tpu.supervisor.faults import ENV_DIE
+
+        save_dir = str(tmp_path / "killed")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **{ENV_DIE: "3"})
+        proc = subprocess.run(
+            [sys.executable, "-m", "simclr_tpu.supervisor", "--", "pretrain",
+             *SYNTH, "parameter.epochs=3", "supervisor.backoff_base_s=0.05",
+             f"experiment.save_dir={save_dir}"],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        summary = json.loads(
+            [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+        )
+        assert summary["outcome"] == "clean" and summary["resumed"] >= 1
+        # the child's last heartbeat telemetry rides into the summary
+        assert summary["telemetry"]["epoch"] == 3.0
+
+        events = read_events(events_path(save_dir))
+        # every epoch exactly once and in order, attempts merged
+        assert [e["epoch"] for e in events if e["event"] == "epoch"] == [1, 2, 3]
+        attempts = {e["attempt"] for e in events}
+        assert {1, 2} <= attempts
+        # both attempts announced themselves; the resume re-seated cleanly
+        assert sum(e["event"] == "run_start" for e in events) >= 2
+        assert any(e["event"] == "resume" and e["attempt"] >= 2 for e in events)
+        # supervisor forensics interleaved in the same file
+        assert any(
+            e["event"] == "child_exit" and e["exit"] != 0 for e in events
+        )
+        assert any(e["event"] == "restart" for e in events)
+        outcome = [e for e in events if e["event"] == "outcome"]
+        assert outcome and outcome[-1]["outcome"] == "clean"
+        # wall-clock ordering holds across the attempt boundary
+        times = [e["time"] for e in events]
+        assert times == sorted(times)

@@ -21,7 +21,7 @@ WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "remat2048", "explore1024", "explore512",
-    "supervisor_smoke",
+    "supervisor_smoke", "obs_smoke",
 )
 
 
@@ -75,6 +75,10 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         'case "$*" in *simclr_tpu.supervisor*) '
         'echo \'{"outcome": "clean", "exit": 0, "attempts": 2, '
         '"resumed": 1, "restarts": {"crashed": 1}}\';; esac',
+        # the obs_smoke stage greps its stdout for a live imgs/s gauge line
+        # from the printed /metrics catalog (rc 0 alone proves nothing)
+        'case "$*" in *obs_smoke.py*) '
+        "echo 'simclr_train_imgs_per_sec 12345.6';; esac",
         # sleep first: the stage's freshness check compares whole-second
         # mtimes, and consecutive tests touch the same file
         'case "$*" in *bench.py*) sleep 1; touch "$BENCH_CAPTURE_PATH";; esac',
@@ -188,6 +192,19 @@ def test_supervisor_marker_requires_an_actual_resume(tmp_path):
     assert "supervisor_smoke" not in _done(state)
     assert (state / "supervisor_smoke.fails").exists()
     assert "stage supervisor_smoke FAILED" in log.read_text()
+
+
+def test_obs_marker_requires_live_throughput_gauge(tmp_path):
+    """obs_smoke exiting 0 without the imgs/s gauge in its printed /metrics
+    catalog (exporter up but telemetry dead) must not earn obs_smoke.done."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        "simclr_train_imgs_per_sec 12345.6", "exporter up, no gauge"))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "obs_smoke" not in _done(state)
+    assert (state / "obs_smoke.fails").exists()
+    assert "stage obs_smoke FAILED" in log.read_text()
 
 
 def test_repeat_offender_is_deferred_not_skipped(tmp_path):

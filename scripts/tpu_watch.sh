@@ -51,7 +51,7 @@ MAX_STAGE_FAILS=3
 # PERF.md's compressed-collectives rows are pending on it), then the
 # remaining step matrices, and last the supervisor kill/resume smoke
 # (fault tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -233,6 +233,27 @@ run_stage() {
             cat "$out" >> "$LOG"
             if [ "$rc" -eq 0 ]; then
                 grep -Eq '^simclr_train_imgs_per_sec [0-9.eE+-]+$' "$out"
+                rc=$?
+            fi ;;
+        run_report)
+            # post-mortem of the obs_smoke run dir judged against the
+            # committed bench capture (simclr_tpu/obs/report.py). Runs
+            # after obs_smoke in the stage order and needs no chip lock —
+            # it only reads files the smoke run left behind. The report
+            # CLI exits 0 whenever it produced a report, so the done
+            # marker requires a COMPUTED verdict (OK|REGRESSION): a
+            # NO_DATA/NO_BASELINE line means the evidence isn't there yet.
+            # threshold 0.05 is a catastrophic-regression floor only — the
+            # smoke run's config is not the bench config, so its imgs/s
+            # legitimately sits far below the tuned capture.
+            out="$STATE/run_report.out"
+            timeout "$(stage_timeout 300)" python -m simclr_tpu.obs.report \
+                /tmp/tpu_watch_obs --baseline "$CAPTURE" --threshold 0.05 \
+                > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -Eq '^run_report verdict: (OK|REGRESSION)' "$out"
                 rc=$?
             fi ;;
         bench)

@@ -475,6 +475,66 @@ def _check_telemetry_conf(cfg: Config) -> None:
         isinstance(events, bool),
         f"telemetry.events must be a boolean (true|false), got {events!r}",
     )
+    anomaly = cfg.select("telemetry.anomaly", True)
+    _require(
+        isinstance(anomaly, bool),
+        f"telemetry.anomaly must be a boolean (true|false), got {anomaly!r}",
+    )
+    warmup = cfg.select("telemetry.anomaly_warmup", 8)
+    _require(
+        isinstance(warmup, int) and not isinstance(warmup, bool)
+        and 2 <= warmup <= 10000,
+        "telemetry.anomaly_warmup must be an int in [2, 10000] step samples "
+        f"before the detector classifies anything, got {warmup!r}",
+    )
+    slow_factor = cfg.select("telemetry.slow_step_factor", 4.0)
+    _require(
+        isinstance(slow_factor, (int, float)) and not isinstance(slow_factor, bool)
+        and 1 <= slow_factor <= 1000,
+        "telemetry.slow_step_factor must be in [1, 1000] MAD multiples over "
+        f"the rolling median, got {slow_factor!r}",
+    )
+    stall_factor = cfg.select("telemetry.stall_factor", 10.0)
+    _require(
+        isinstance(stall_factor, (int, float)) and not isinstance(stall_factor, bool)
+        and 1 <= stall_factor <= 1000,
+        "telemetry.stall_factor must be in [1, 1000] multiples of the median "
+        f"step time (stall watchdog deadline), got {stall_factor!r}",
+    )
+    stall_min = cfg.select("telemetry.stall_min_s", 2.0)
+    _require(
+        isinstance(stall_min, (int, float)) and not isinstance(stall_min, bool)
+        and 0 < stall_min <= 3600,
+        "telemetry.stall_min_s must be in (0, 3600] seconds (floor on the "
+        f"stall watchdog deadline), got {stall_min!r}",
+    )
+    auto_trace = cfg.select("telemetry.auto_trace", False)
+    _require(
+        isinstance(auto_trace, bool),
+        f"telemetry.auto_trace must be a boolean (true|false), got {auto_trace!r}",
+    )
+    auto_trace_ms = cfg.select("telemetry.auto_trace_ms", 500)
+    _require(
+        isinstance(auto_trace_ms, (int, float))
+        and not isinstance(auto_trace_ms, bool)
+        and 0 < auto_trace_ms <= 60000,
+        "telemetry.auto_trace_ms must be in (0, 60000] milliseconds per "
+        f"automatic capture, got {auto_trace_ms!r}",
+    )
+    cooldown = cfg.select("telemetry.auto_trace_cooldown_s", 300.0)
+    _require(
+        isinstance(cooldown, (int, float)) and not isinstance(cooldown, bool)
+        and 0 <= cooldown <= 86400,
+        "telemetry.auto_trace_cooldown_s must be in [0, 86400] seconds "
+        f"between automatic captures, got {cooldown!r}",
+    )
+    auto_trace_max = cfg.select("telemetry.auto_trace_max", 3)
+    _require(
+        isinstance(auto_trace_max, int) and not isinstance(auto_trace_max, bool)
+        and 1 <= auto_trace_max <= 100,
+        "telemetry.auto_trace_max must be an int in [1, 100] automatic "
+        f"captures per attempt, got {auto_trace_max!r}",
+    )
 
 
 def check_supervisor_conf(cfg: Config) -> None:
@@ -567,6 +627,19 @@ def check_serve_conf(cfg: Config) -> None:
     _require(int(s.queue_depth) > 0, "serve.queue_depth must be positive")
     _require(float(s.request_timeout_s) > 0, "serve.request_timeout_s must be positive")
     _require(0 <= int(s.port) <= 65535, "serve.port must be in [0, 65535]")
+    rate = cfg.select("serve.trace_sample_rate", 0.0)
+    _require(
+        isinstance(rate, (int, float)) and not isinstance(rate, bool)
+        and 0.0 <= rate <= 1.0,
+        "serve.trace_sample_rate must be in [0.0, 1.0] (fraction of request "
+        f"traces sampled into serve.requests_log), got {rate!r}",
+    )
+    requests_log = cfg.select("serve.requests_log")
+    _require(
+        requests_log is None or isinstance(requests_log, str),
+        "serve.requests_log must be a path string or null (null = no "
+        f"sidecar), got {requests_log!r}",
+    )
     # one of the checkpoint sources must be real
     if not s.get("checkpoint"):
         _require(

@@ -38,6 +38,7 @@ from simclr_tpu.data.cifar import load_dataset
 from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import ContrastiveModel
+from simclr_tpu.obs.anomaly import maybe_detector
 from simclr_tpu.obs.events import EventLog
 from simclr_tpu.obs.exporter import maybe_start_exporter
 from simclr_tpu.obs.telemetry import Telemetry
@@ -189,6 +190,13 @@ def run_pretrain(cfg: Config) -> dict:
         nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
         telemetry=telemetry,
         events=events,
+    )
+    # step anomaly detection (obs/anomaly.py): rolling median/MAD slow-step
+    # classifier + stall watchdog + rate-limited auto-trace — host clock
+    # reads only, zero extra device syncs
+    detector = (
+        maybe_detector(cfg, save_dir, telemetry=telemetry, events=events)
+        if is_logging_host() else None
     )
     events.emit(
         "run_start", entry="pretrain", epochs=epochs,
@@ -476,6 +484,10 @@ def run_pretrain(cfg: Config) -> dict:
                 metrics = {"loss": hist["loss"][-1]}
                 timer.tick(hist["loss"])
                 cur_step += steps_per_epoch
+                if detector is not None:
+                    # one tick per epoch here: the detector's "step" unit is
+                    # whatever the host loop's unit of progress is
+                    detector.tick(cur_step, epoch)
             else:
                 batches = iterator.batches(epoch)
                 if skip_steps:
@@ -490,9 +502,19 @@ def run_pretrain(cfg: Config) -> dict:
                     state, metrics = step_fn(state, batch["image"], step_rng)
                     timer.tick(metrics["loss"])
                     cur_step += 1
+                    if detector is not None:
+                        # BEFORE the beat: the beat is where fault injection
+                        # wedges, and the watchdog must already be armed to
+                        # catch exactly that class of hang
+                        detector.tick(cur_step, epoch)
                     guard.beat(cur_step, epoch)
                     if guard.preempt_requested:
                         break
+            if detector is not None:
+                # epoch-boundary work (probe, checkpoint I/O, preempt saves)
+                # is not a step: disarm so it can never read as a stall, and
+                # keep its duration out of the step-time window
+                detector.pause()
             if guard.preempt_requested:
                 # land a resumable checkpoint at this step boundary, then
                 # exit 75 via main() — at an exact epoch boundary this is the
@@ -594,6 +616,8 @@ def run_pretrain(cfg: Config) -> dict:
             epoch += 1
     finally:
         guard.restore_signals()
+        if detector is not None:
+            detector.close()
         if exporter is not None:
             exporter.close()
 

@@ -11,11 +11,19 @@ One subsystem shared by every tier of the system (docs/OBSERVABILITY.md):
   * :mod:`~simclr_tpu.obs.events` — structured ``events.jsonl`` timeline in
     the run dir, shared by the trainers and the supervisor runner;
   * :mod:`~simclr_tpu.obs.exporter` — process-0 daemon HTTP exporter
-    (``/metrics``, ``/healthz``, ``POST /debug/trace?ms=N``).
+    (``/metrics``, ``/healthz``, ``POST /debug/trace?ms=N``);
+  * :mod:`~simclr_tpu.obs.trace` — request-scoped span tracing for the
+    serve tier (``X-Request-Id``, ``GET /debug/slow``, ``requests.jsonl``);
+  * :mod:`~simclr_tpu.obs.anomaly` — rolling median/MAD step anomaly
+    detector with a stall watchdog and rate-limited automatic profiler
+    captures;
+  * :mod:`~simclr_tpu.obs.report` — post-mortem run reports with a
+    throughput-regression verdict (``python -m simclr_tpu.obs.report``).
 
-``metrics`` and ``events`` are stdlib-only so the supervisor runner and the
-serve tier import them without paying for (or touching) jax; ``telemetry``
-and ``exporter`` defer anything heavier to call time.
+``metrics``, ``events``, ``trace``, and ``report`` are stdlib-only so the
+supervisor runner and the serve tier import them without paying for (or
+touching) jax; ``telemetry``, ``anomaly``, and ``exporter`` defer anything
+heavier to call time.
 """
 
 from __future__ import annotations

@@ -65,11 +65,21 @@ class TelemetryHandler(BaseHTTPRequestHandler):
         pass  # scrapes every few seconds would flood the training log
 
     def _send(self, code: int, body: bytes, content_type: str) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # a scraper hanging up mid-response is routine, not an error:
+            # count it instead of tracebacking onto the training log
+            record = getattr(
+                self.server.telemetry, "record_scrape_disconnect", None
+            )
+            if record is not None:
+                record()
+            self.close_connection = True
 
     def _send_json(self, code: int, payload: dict) -> None:
         self._send(code, json.dumps(payload).encode(), "application/json")
@@ -125,8 +135,9 @@ class TelemetryHandler(BaseHTTPRequestHandler):
 class TelemetryExporter:
     """The running exporter: server + daemon accept-loop thread."""
 
-    def __init__(self, server: TelemetryHTTPServer):
+    def __init__(self, server: TelemetryHTTPServer, ready_file: str | None = None):
         self.server = server
+        self.ready_file = ready_file
         self.host, self.port = server.server_address[:2]
         self._thread = threading.Thread(
             target=server.serve_forever,
@@ -140,6 +151,14 @@ class TelemetryExporter:
         self.server.shutdown()
         self._thread.join(timeout=5.0)
         self.server.server_close()
+        if self.ready_file:
+            # the published {host, port, pid} is dead the moment the socket
+            # closes; leaving it behind would point orchestration at a port
+            # some other process may reuse
+            try:
+                os.unlink(self.ready_file)
+            except OSError:
+                pass
 
 
 def start_exporter(
@@ -153,7 +172,7 @@ def start_exporter(
 ) -> TelemetryExporter:
     """Bind, publish the address if asked, and start serving (daemon)."""
     server = TelemetryHTTPServer((host, int(port)), telemetry, save_dir, trace_max_ms)
-    exporter = TelemetryExporter(server)
+    exporter = TelemetryExporter(server, ready_file=str(ready_file) if ready_file else None)
     if ready_file:
         from simclr_tpu.utils.ioutil import atomic_write
 

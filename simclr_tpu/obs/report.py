@@ -1,0 +1,255 @@
+"""Post-mortem run reports: ``python -m simclr_tpu.obs.report <run_dir>``.
+
+Merges everything a finished (or dead) run left behind — the
+``events.jsonl`` timeline, the final ``heartbeat.json`` with its
+telemetry snapshot, ``supervisor_summary.json`` when the run was
+supervised — into one per-attempt post-mortem, and judges the run's
+throughput against a named ``BENCH_*.json`` baseline:
+
+    python -m simclr_tpu.obs.report results/run --baseline BENCH_TPU_CAPTURE.json
+
+The last output line is always machine-greppable::
+
+    run_report verdict: OK|REGRESSION|NO_BASELINE|NO_DATA (...)
+
+``OK``/``REGRESSION`` mean a measured-vs-baseline imgs/sec/chip ratio
+was actually computed (``REGRESSION`` when it falls below
+``--threshold``); ``NO_BASELINE``/``NO_DATA`` mean the comparison could
+not happen.  The CLI exits 0 whenever a report was produced — the
+verdict line, not the exit code, carries the judgement (the
+``run_report`` stage in ``scripts/tpu_watch.sh`` greps for it).
+
+Deliberately jax-free (stdlib + ``obs.events`` + ``supervisor.heartbeat``,
+both stdlib-only) so it runs on any machine holding the run directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from simclr_tpu.obs.events import events_path, read_events
+from simclr_tpu.supervisor.heartbeat import heartbeat_path, read_heartbeat
+
+VERDICT_OK = "OK"
+VERDICT_REGRESSION = "REGRESSION"
+VERDICT_NO_BASELINE = "NO_BASELINE"
+VERDICT_NO_DATA = "NO_DATA"
+
+DEFAULT_THRESHOLD = 0.8
+
+SUMMARY_NAME = "supervisor_summary.json"
+
+_COUNTED_EVENTS = {
+    "epoch": "epochs",
+    "checkpoint": "checkpoints",
+    "slow_step": "slow_steps",
+    "stall": "stalls",
+    "auto_trace": "auto_traces",
+    "nan_rollback": "nan_rollbacks",
+    "preempt": "preempts",
+}
+
+
+def _load_json(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def load_baseline(path: str) -> float | None:
+    """imgs/sec/chip out of a ``BENCH_*.json`` artifact, or None.
+
+    Handles both shapes the bench tooling writes: the committed capture
+    (``{"payload": {"metric": "pretrain_imgs_per_sec_per_chip",
+    "value": ...}}``) and a raw probe attempt (``{"parsed": {...}}`` —
+    whose ``parsed`` is null when the probe died).
+    """
+    payload = _load_json(path)
+    if payload is None:
+        return None
+    node = payload.get("payload") or payload.get("parsed") or payload
+    if not isinstance(node, dict):
+        return None
+    if node.get("metric") == "pretrain_imgs_per_sec_per_chip":
+        value = node.get("value")
+    else:
+        value = node.get("imgs_per_sec_per_chip")
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return value if value > 0 else None
+
+
+def build_report(
+    run_dir: str,
+    *,
+    baseline_path: str | None = None,
+    threshold: float = DEFAULT_THRESHOLD,
+) -> dict:
+    events = read_events(events_path(run_dir))
+    attempts: dict[int, dict] = {}
+    for event in events:
+        try:
+            attempt = int(event.get("attempt", 1))
+        except (TypeError, ValueError):
+            attempt = 1
+        entry = attempts.setdefault(
+            attempt,
+            {
+                **{field: 0 for field in _COUNTED_EVENTS.values()},
+                "exit": None,
+                "hung": False,
+                "first_time": None,
+                "last_time": None,
+            },
+        )
+        kind = event.get("event")
+        if kind in _COUNTED_EVENTS:
+            entry[_COUNTED_EVENTS[kind]] += 1
+        elif kind == "child_exit":
+            entry["exit"] = event.get("exit")
+            entry["hung"] = bool(event.get("hung"))
+        when = event.get("time")
+        if isinstance(when, (int, float)):
+            if entry["first_time"] is None:
+                entry["first_time"] = when
+            entry["last_time"] = when
+    for entry in attempts.values():
+        if entry["first_time"] is not None:
+            entry["duration_s"] = round(entry["last_time"] - entry["first_time"], 3)
+        else:
+            entry["duration_s"] = None
+
+    stalled = sorted(
+        a for a, entry in attempts.items() if entry["stalls"] or entry["hung"]
+    )
+
+    heartbeat = read_heartbeat(heartbeat_path(run_dir))
+    telemetry = None
+    if heartbeat is not None and isinstance(heartbeat.get("telemetry"), dict):
+        telemetry = heartbeat["telemetry"]
+    supervisor = _load_json(os.path.join(run_dir, SUMMARY_NAME))
+
+    measured = None
+    if telemetry is not None:
+        try:
+            measured = float(telemetry.get("imgs_per_sec_per_chip"))
+        except (TypeError, ValueError):
+            measured = None
+        if measured is not None and measured <= 0:
+            measured = None
+
+    baseline = load_baseline(baseline_path) if baseline_path else None
+
+    ratio = None
+    if not events and heartbeat is None:
+        verdict = VERDICT_NO_DATA
+    elif baseline is None:
+        verdict = VERDICT_NO_BASELINE
+    elif measured is None:
+        verdict = VERDICT_NO_DATA
+    else:
+        ratio = measured / baseline
+        verdict = VERDICT_OK if ratio >= threshold else VERDICT_REGRESSION
+
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "attempts": {str(a): attempts[a] for a in sorted(attempts)},
+        "stalled_attempts": stalled,
+        "outcome": supervisor.get("outcome") if supervisor else None,
+        "supervisor": supervisor,
+        "heartbeat": heartbeat,
+        "telemetry": telemetry,
+        "measured_imgs_per_sec_per_chip": measured,
+        "baseline_imgs_per_sec_per_chip": baseline,
+        "threshold": threshold,
+        "throughput_ratio": round(ratio, 4) if ratio is not None else None,
+        "verdict": verdict,
+    }
+
+
+def render_report(report: dict) -> str:
+    lines = [f"run report: {report['run_dir']}"]
+    if report["outcome"] is not None:
+        supervisor = report["supervisor"] or {}
+        lines.append(
+            f"outcome: {report['outcome']} "
+            f"(exit {supervisor.get('exit')}, "
+            f"resumed {supervisor.get('resumed', 0)}x)"
+        )
+    for attempt, entry in report["attempts"].items():
+        duration = (
+            f"{entry['duration_s']:.1f}s"
+            if entry["duration_s"] is not None
+            else "?"
+        )
+        exit_part = "" if entry["exit"] is None else f" exit={entry['exit']}"
+        hung_part = " HUNG" if entry["hung"] else ""
+        lines.append(
+            f"attempt {attempt}: {duration} epochs={entry['epochs']} "
+            f"checkpoints={entry['checkpoints']} "
+            f"slow_steps={entry['slow_steps']} stalls={entry['stalls']} "
+            f"auto_traces={entry['auto_traces']} "
+            f"nan_rollbacks={entry['nan_rollbacks']} "
+            f"preempts={entry['preempts']}{exit_part}{hung_part}"
+        )
+    if report["stalled_attempts"]:
+        lines.append(
+            "stalled attempts: "
+            + ", ".join(str(a) for a in report["stalled_attempts"])
+        )
+    detail = (
+        f"imgs/s/chip measured={report['measured_imgs_per_sec_per_chip']} "
+        f"baseline={report['baseline_imgs_per_sec_per_chip']} "
+        f"ratio={report['throughput_ratio']} "
+        f"threshold={report['threshold']}"
+    )
+    # keep this the LAST line and the format stable: tooling greps
+    # '^run_report verdict: ' (scripts/tpu_watch.sh run_report stage)
+    lines.append(f"run_report verdict: {report['verdict']} ({detail})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m simclr_tpu.obs.report",
+        description="Per-attempt post-mortem of a run directory with a "
+        "throughput-regression verdict.",
+    )
+    parser.add_argument("run_dir", help="run save_dir holding events.jsonl etc.")
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="BENCH_*.json artifact holding the imgs/sec/chip baseline",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=DEFAULT_THRESHOLD,
+        help="minimum measured/baseline ratio judged OK (default %(default)s)",
+    )
+    parser.add_argument(
+        "--json", default=None, help="also write the full report to this path"
+    )
+    args = parser.parse_args(argv)
+
+    report = build_report(
+        args.run_dir, baseline_path=args.baseline, threshold=args.threshold
+    )
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(render_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,6 +140,20 @@ class Telemetry:
         self.nan_rollbacks = Counter(
             "simclr_train_nan_rollbacks_total",
             "Non-finite-loss rollbacks booked against the retry budget")
+        self.anomaly_slow_steps = Counter(
+            "simclr_train_anomaly_slow_steps_total",
+            "Steps classified slow by the rolling median/MAD detector "
+            "(obs/anomaly.py)")
+        self.anomaly_stalls = Counter(
+            "simclr_train_anomaly_stalls_total",
+            "Stall-watchdog firings: no step completed within the armed "
+            "deadline")
+        self.auto_traces = Counter(
+            "simclr_train_auto_traces_total",
+            "Automatic profiler captures fired by the anomaly detector")
+        self.scrape_disconnects = Counter(
+            "simclr_train_scrape_disconnects_total",
+            "Scrape responses dropped mid-write by a disconnecting peer")
         self.grad_allreduce_mode = str(grad_allreduce)
         if grad_elements:
             from simclr_tpu.parallel.compress import allreduce_wire_bytes
@@ -158,6 +172,8 @@ class Telemetry:
             self.step, self.val_acc, self.allreduce_wire_bytes,
             self.checkpoint_save_seconds, self.checkpoint_restore_seconds,
             self.checkpoint_saves, self.nan_rollbacks,
+            self.anomaly_slow_steps, self.anomaly_stalls, self.auto_traces,
+            self.scrape_disconnects,
         )
         self._started = time.time()
 
@@ -206,6 +222,18 @@ class Telemetry:
     def record_nan_rollback(self) -> None:
         self.nan_rollbacks.inc()
 
+    def record_slow_step(self) -> None:
+        self.anomaly_slow_steps.inc()
+
+    def record_stall(self) -> None:
+        self.anomaly_stalls.inc()
+
+    def record_auto_trace(self) -> None:
+        self.auto_traces.inc()
+
+    def record_scrape_disconnect(self) -> None:
+        self.scrape_disconnects.inc()
+
     # -- read side ----------------------------------------------------------
     def snapshot(self) -> dict:
         """The compact latest-values dict riding on ``heartbeat.json`` (and
@@ -218,6 +246,9 @@ class Telemetry:
             "imgs_per_sec": self.imgs_per_sec.value,
             "imgs_per_sec_per_chip": self.imgs_per_sec_per_chip.value,
             "mfu": self.mfu.value,
+            "slow_steps": self.anomaly_slow_steps.value,
+            "stalls": self.anomaly_stalls.value,
+            "auto_traces": self.auto_traces.value,
             "uptime_s": round(time.time() - self._started, 3),
         }
 

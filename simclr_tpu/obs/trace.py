@@ -1,0 +1,169 @@
+"""Request tracing: monotonic-clock spans with no dependencies.
+
+The serve tier answers "why was THIS request slow" with a per-request
+span breakdown instead of an aggregate histogram: every ``/v1/embed``
+request carries a :class:`RequestTrace` through handler -> batcher ->
+engine, collecting queue-wait / coalesce / pad / device-compute /
+serialize spans stamped from ``time.perf_counter()``.  Completed traces
+land in a :class:`TraceRecorder`, which keeps a bounded ring of the
+slowest requests (served at ``GET /debug/slow``) and optionally samples
+a deterministic fraction into a ``requests.jsonl`` sidecar.
+
+Everything here is host-clock arithmetic on floats the serve path
+already computes — tracing never touches a device value, so the
+zero-sync discipline of ``docs/OBSERVABILITY.md`` holds with spans on.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import threading
+import time
+import uuid
+
+from simclr_tpu.utils.ioutil import atomic_append
+
+# default depth of the slowest-requests ring at GET /debug/slow
+SLOW_RING_CAPACITY = 32
+
+_MAX_REQUEST_ID_LEN = 128
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def clean_request_id(raw) -> str:
+    """A usable request id: the client-supplied header value sanitized
+    (printable, no whitespace, bounded length), or a fresh one."""
+    if raw is not None:
+        rid = "".join(
+            c for c in str(raw).strip() if c.isprintable() and not c.isspace()
+        )
+        rid = rid[:_MAX_REQUEST_ID_LEN]
+        if rid:
+            return rid
+    return new_request_id()
+
+
+class RequestTrace:
+    """Span collection for one request.
+
+    Spans are ``(name, start, end)`` tuples in ``time.perf_counter()``
+    seconds.  A trace crosses threads exactly once (handler -> batcher
+    worker and back through the Future, which gives happens-before), but
+    a lock keeps ``add`` safe regardless.
+    """
+
+    __slots__ = ("request_id", "t0", "_spans", "_lock")
+
+    def __init__(self, request_id: str | None = None):
+        self.request_id = request_id or new_request_id()
+        self.t0 = time.perf_counter()
+        self._spans: list[tuple[str, float, float]] = []
+        self._lock = threading.Lock()
+
+    def add(self, name: str, start: float, end: float) -> None:
+        with self._lock:
+            self._spans.append((str(name), float(start), float(end)))
+
+    def span(self, name: str) -> "_SpanContext":
+        """``with trace.span("serialize"): ...`` stamps one span."""
+        return _SpanContext(self, name)
+
+    def spans(self) -> list[tuple[str, float, float]]:
+        with self._lock:
+            return list(self._spans)
+
+    def total_s(self) -> float:
+        """Request start to the last span end (0 if no spans yet)."""
+        end = max((e for _, _, e in self.spans()), default=self.t0)
+        return end - self.t0
+
+    def to_dict(self) -> dict:
+        spans = self.spans()
+        end = max((e for _, _, e in spans), default=self.t0)
+        return {
+            "request_id": self.request_id,
+            "total_ms": round((end - self.t0) * 1000.0, 3),
+            "spans": [
+                {
+                    "name": name,
+                    "start_ms": round((start - self.t0) * 1000.0, 3),
+                    "dur_ms": round((span_end - start) * 1000.0, 3),
+                }
+                for name, start, span_end in spans
+            ],
+        }
+
+
+class _SpanContext:
+    __slots__ = ("_trace", "_name", "_start")
+
+    def __init__(self, trace: RequestTrace, name: str):
+        self._trace = trace
+        self._name = name
+
+    def __enter__(self) -> "_SpanContext":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._trace.add(self._name, self._start, time.perf_counter())
+
+
+class TraceRecorder:
+    """Terminal sink for completed traces.
+
+    Keeps the ``capacity`` slowest traces in a min-heap (evict the
+    fastest when full) for ``GET /debug/slow``, and — when ``path`` and
+    ``sample_rate`` are set — appends every Nth completed trace as one
+    JSON line.  Sampling uses a deterministic accumulator rather than a
+    PRNG so a rate of 0.25 means exactly every 4th request, which keeps
+    the sidecar's growth rate predictable.
+    """
+
+    def __init__(
+        self,
+        *,
+        sample_rate: float = 0.0,
+        path: str | None = None,
+        capacity: int = SLOW_RING_CAPACITY,
+    ):
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError(f"sample_rate must be in [0, 1], got {sample_rate}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.sample_rate = float(sample_rate)
+        self.path = path
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        # (total_ms, seq, record): seq breaks ties so dicts never compare
+        self._heap: list[tuple[float, int, dict]] = []
+        self._seq = 0
+        self._accum = 0.0
+
+    def record(self, trace: RequestTrace) -> dict:
+        rec = {"time": round(time.time(), 6), **trace.to_dict()}
+        total_ms = rec["total_ms"]
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._heap, (total_ms, self._seq, rec))
+            if len(self._heap) > self.capacity:
+                heapq.heappop(self._heap)
+            sampled = False
+            if self.path and self.sample_rate > 0.0:
+                self._accum += self.sample_rate
+                if self._accum >= 1.0:
+                    self._accum -= 1.0
+                    sampled = True
+        if sampled:
+            atomic_append(self.path, json.dumps(rec) + "\n")
+        return rec
+
+    def slowest(self) -> list[dict]:
+        """Retained traces, slowest first (most recent wins ties)."""
+        with self._lock:
+            items = sorted(self._heap, key=lambda t: (-t[0], -t[1]))
+        return [rec for _, _, rec in items]

@@ -45,6 +45,10 @@ class _Pending:
     images: np.ndarray
     future: Future = field(default_factory=Future)
     submitted_at: float = field(default_factory=time.perf_counter)
+    # optional obs.trace.RequestTrace riding along; picked_at is stamped by
+    # the worker when the request leaves the queue (queue_wait span end)
+    trace: object | None = None
+    picked_at: float | None = None
 
     @property
     def n_rows(self) -> int:
@@ -67,6 +71,7 @@ class DynamicBatcher:
         max_delay_ms: float = 5.0,
         queue_depth: int = 64,
         metrics=None,
+        span_source=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -75,6 +80,10 @@ class DynamicBatcher:
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         self._embed_fn = embed_fn
+        # () -> iterable of (name, start, end) spans describing the LAST
+        # embed_fn call (the engine's pad/device_compute breakdown); read
+        # only from the worker thread, right after each dispatch
+        self._span_source = span_source
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.metrics = metrics
@@ -89,8 +98,11 @@ class DynamicBatcher:
             metrics.queue_depth.set_fn(self._q.qsize)
 
     # -- producer side (HTTP handler threads) ------------------------------
-    def submit(self, images: np.ndarray) -> Future:
+    def submit(self, images: np.ndarray, trace=None) -> Future:
         """Enqueue one request; returns a Future of its ``(n, d)`` embeddings.
+
+        ``trace`` (an ``obs.trace.RequestTrace``) collects the request's
+        queue_wait/coalesce spans plus the engine's per-batch spans.
 
         Raises :class:`BatcherClosedError` during shutdown and
         :class:`BackpressureError` when the queue is full — both BEFORE
@@ -99,7 +111,7 @@ class DynamicBatcher:
         """
         if self._closed.is_set():
             raise BatcherClosedError("batcher is draining; not accepting requests")
-        item = _Pending(np.asarray(images))
+        item = _Pending(np.asarray(images), trace=trace)
         if not 0 < item.n_rows <= self.max_batch:
             raise ValueError(
                 f"request must carry 1..{self.max_batch} rows, got {item.n_rows}"
@@ -130,6 +142,7 @@ class DynamicBatcher:
                     if self._closed.is_set():
                         return  # drained: intake stopped and queue empty
                     continue
+                first.picked_at = time.perf_counter()
             batch = [first]
             rows = first.n_rows
             deadline = time.perf_counter() + self.max_delay_s
@@ -140,6 +153,7 @@ class DynamicBatcher:
                     )
                 except queue.Empty:
                     break
+                nxt.picked_at = time.perf_counter()
                 if rows + nxt.n_rows > self.max_batch:
                     carry = nxt  # opens the next batch; never dropped
                     break
@@ -153,6 +167,7 @@ class DynamicBatcher:
     def _dispatch(self, batch: list[_Pending]) -> None:
         if self.metrics is not None:
             self.metrics.batch_requests_total.inc(len(batch))
+        dispatched_at = time.perf_counter()
         try:
             images = (
                 batch[0].images
@@ -167,8 +182,22 @@ class DynamicBatcher:
                 p.future.set_exception(e)
             return
         done = time.perf_counter()
+        engine_spans = ()
+        if self._span_source is not None:
+            try:
+                engine_spans = tuple(self._span_source())
+            except Exception:  # never let tracing break a dispatch
+                engine_spans = ()
         offset = 0
         for p in batch:
+            if p.trace is not None:
+                # spans are complete before the future resolves, so the
+                # handler thread reads a finished trace
+                picked = p.picked_at if p.picked_at is not None else dispatched_at
+                p.trace.add("queue_wait", p.submitted_at, picked)
+                p.trace.add("coalesce", picked, dispatched_at)
+                for name, start, end in engine_spans:
+                    p.trace.add(name, start, end)
             p.future.set_result(out[offset : offset + p.n_rows])
             offset += p.n_rows
             if self.metrics is not None:

@@ -87,6 +87,11 @@ class EmbedEngine:
         self.buckets = make_buckets(self.max_batch)
         self.metrics = metrics
         self._warm: set[int] = set()
+        # (name, start, end) perf_counter spans of the LAST embed() call
+        # (pad + device_compute), read by the batcher's span_source. embed()
+        # runs only on the batcher's single worker thread (see embed()), so
+        # a plain attribute swap is safe.
+        self.last_spans: tuple = ()
         # one committed device copy of the variables, shared by every bucket
         # program — per-request device_put of the params would dominate the
         # forward at small batches
@@ -173,19 +178,22 @@ class EmbedEngine:
                 self.metrics.compile_cache_misses_total.inc()
         if bucket not in self._warm:
             self._warm.add(bucket)
+        t_pad = time.perf_counter()
         if n < bucket:
             images = np.concatenate(
                 [images, np.zeros((bucket - n, *self.input_shape), np.uint8)]
             )
         t0 = time.perf_counter()
         out = fetch(self._fwd(self._params, self._batch_stats, images))
+        done = time.perf_counter()
+        # kept even for exact-bucket batches (a ~0 pad span) so every
+        # request trace carries the same span shape
+        self.last_spans = (("pad", t_pad, t0), ("device_compute", t0, done))
         if self.metrics is not None:
             self.metrics.batches_total.inc()
             self.metrics.batch_rows_total.inc(n)
             self.metrics.batch_capacity_total.inc(bucket)
-            self.metrics.batch_latency_ms.observe(
-                (time.perf_counter() - t0) * 1000.0
-            )
+            self.metrics.batch_latency_ms.observe((done - t0) * 1000.0)
         return out[:n]
 
     @property

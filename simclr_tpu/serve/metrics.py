@@ -58,6 +58,9 @@ class ServeMetrics:
         self.batch_latency_ms = Summary(
             "simclr_serve_batch_latency_ms",
             "Engine forward latency per dispatched batch (milliseconds)")
+        self.client_disconnects_total = Counter(
+            "simclr_serve_client_disconnects_total",
+            "Responses dropped mid-write by a disconnecting client")
 
     def avg_batch_fill(self) -> float:
         """Mean requests coalesced per dispatched engine batch."""
@@ -79,6 +82,7 @@ class ServeMetrics:
                 self.batch_capacity_total, self.compile_cache_hits_total,
                 self.compile_cache_misses_total, self.queue_depth,
                 self.request_latency_ms, self.batch_latency_ms,
+                self.client_disconnects_total,
             )
         ]
         parts.append(

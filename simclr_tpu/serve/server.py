@@ -10,6 +10,16 @@ Endpoints:
     retry with backoff), 500 engine failure, 503 draining.
   * ``GET /healthz`` — 200 once warm and accepting, 503 while draining.
   * ``GET /metrics`` — Prometheus text format (``serve/metrics.py``).
+  * ``GET /debug/slow`` — the slowest recent requests with their span
+    breakdowns (``obs/trace.py`` ring buffer).
+
+Request tracing: every ``/v1/embed`` request gets an ``X-Request-Id``
+(client-supplied, sanitized, or generated), echoed on the response and
+used in log lines, and records queue_wait / coalesce / pad /
+device_compute / serialize spans into the server's
+:class:`~simclr_tpu.obs.trace.TraceRecorder` — which also samples
+completed traces into ``serve.requests_log`` at
+``serve.trace_sample_rate``.
 
 Shutdown contract (tested): SIGTERM (or SIGINT) flips the server into
 draining — new embeds get 503, ``/healthz`` reports draining — then the
@@ -32,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from simclr_tpu.obs.trace import RequestTrace, TraceRecorder, clean_request_id
 from simclr_tpu.serve.batcher import BackpressureError, BatcherClosedError
 from simclr_tpu.utils.logging import get_logger
 
@@ -49,12 +60,21 @@ class EmbedServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, address, engine, batcher, metrics, request_timeout_s=30.0):
+    def __init__(
+        self,
+        address,
+        engine,
+        batcher,
+        metrics,
+        request_timeout_s=30.0,
+        recorder: TraceRecorder | None = None,
+    ):
         super().__init__(address, EmbedHandler)
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
         self.request_timeout_s = float(request_timeout_s)
+        self.recorder = recorder if recorder is not None else TraceRecorder()
         self.draining = threading.Event()
 
 
@@ -72,13 +92,23 @@ class EmbedHandler(BaseHTTPRequestHandler):
         logger.debug("http %s", fmt % args)
 
     def _send(self, code: int, body: bytes, content_type: str, headers=()) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in headers:
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            rid = getattr(self, "_request_id", None)
+            if rid:
+                self.send_header("X-Request-Id", rid)
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # client hung up mid-response: routine (impatient callers,
+            # load-balancer health probes) — count it, don't traceback
+            if self.server.metrics is not None:
+                self.server.metrics.client_disconnects_total.inc()
+            self.close_connection = True
 
     def _send_json(self, code: int, payload: dict, headers=()) -> None:
         self._send(
@@ -87,6 +117,9 @@ class EmbedHandler(BaseHTTPRequestHandler):
 
     # -- GET ---------------------------------------------------------------
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        # one handler instance serves a whole keep-alive connection; clear
+        # any id left by a previous POST on the same socket
+        self._request_id = None
         if self.path == "/healthz":
             if self.server.draining.is_set():
                 self._send_json(503, {"status": "draining"})
@@ -109,11 +142,16 @@ class EmbedHandler(BaseHTTPRequestHandler):
                 self.server.metrics.render().encode(),
                 "text/plain; version=0.0.4",
             )
+        elif self.path == "/debug/slow":
+            self._send_json(200, {"slowest": self.server.recorder.slowest()})
         else:
             self._send_json(404, {"error": f"no such path {self.path!r}"})
 
     # -- POST --------------------------------------------------------------
     def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        # resolved first so EVERY response (including errors) echoes the id
+        rid = clean_request_id(self.headers.get("X-Request-Id"))
+        self._request_id = rid
         if self.path != "/v1/embed":
             self._send_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -125,10 +163,12 @@ class EmbedHandler(BaseHTTPRequestHandler):
         try:
             images = self._parse_instances()
         except _BadRequest as e:
+            logger.debug("embed %s rejected (%d): %s", rid, e.code, e)
             self._send_json(e.code, {"error": str(e)})
             return
+        trace = RequestTrace(rid)
         try:
-            future = self.server.batcher.submit(images)
+            future = self.server.batcher.submit(images, trace=trace)
         except BackpressureError as e:
             self._send_json(429, {"error": str(e)}, [("Retry-After", "1")])
             return
@@ -138,6 +178,11 @@ class EmbedHandler(BaseHTTPRequestHandler):
         try:
             embeddings = future.result(timeout=self.server.request_timeout_s)
         except (TimeoutError, _FutureTimeout):
+            logger.warning(
+                "embed %s timed out after %.1fs",
+                rid,
+                self.server.request_timeout_s,
+            )
             self._send_json(
                 504,
                 {"error": f"embed timed out after {self.server.request_timeout_s}s"},
@@ -147,16 +192,22 @@ class EmbedHandler(BaseHTTPRequestHandler):
             self._send_json(503, {"error": str(e)})
             return
         except Exception as e:  # engine failure — already counted by batcher
+            logger.warning("embed %s failed in engine: %r", rid, e)
             self._send_json(500, {"error": repr(e)})
             return
-        self._send_json(
-            200,
-            {
-                "embeddings": [
-                    [float(v) for v in row] for row in np.asarray(embeddings)
-                ],
-            },
+        # ndarray.tolist() converts float32 -> exact Python double in C
+        # (same shortest-repr doubles as the old per-element loop, so the
+        # JSON round trip stays bitwise exact — tested), without an O(n*d)
+        # Python-level loop
+        with trace.span("serialize"):
+            body = json.dumps(
+                {"embeddings": np.asarray(embeddings).tolist()}
+            ).encode()
+        rec = self.server.recorder.record(trace)
+        logger.debug(
+            "embed %s: %d rows in %.1f ms", rid, len(embeddings), rec["total_ms"]
         )
+        self._send(200, body, "application/json")
 
     def _parse_instances(self) -> np.ndarray:
         length = int(self.headers.get("Content-Length") or 0)
@@ -272,6 +323,12 @@ def start_server(cfg, *, engine=None, metrics=None) -> tuple:
         max_delay_ms=float(cfg.serve.max_delay_ms),
         queue_depth=int(cfg.serve.queue_depth),
         metrics=metrics,
+        span_source=lambda: getattr(engine, "last_spans", ()),
+    )
+    requests_log = cfg.select("serve.requests_log")
+    recorder = TraceRecorder(
+        sample_rate=float(cfg.select("serve.trace_sample_rate", 0.0) or 0.0),
+        path=str(requests_log) if requests_log else None,
     )
     server = EmbedServer(
         (str(cfg.serve.host), int(cfg.serve.port)),
@@ -279,6 +336,7 @@ def start_server(cfg, *, engine=None, metrics=None) -> tuple:
         batcher,
         metrics,
         request_timeout_s=float(cfg.serve.request_timeout_s),
+        recorder=recorder,
     )
     return server, batcher
 

@@ -33,6 +33,7 @@ from simclr_tpu.data.cifar import NUM_CLASSES, load_dataset
 from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import SupervisedModel
+from simclr_tpu.obs.anomaly import maybe_detector
 from simclr_tpu.obs.events import EventLog
 from simclr_tpu.obs.exporter import maybe_start_exporter
 from simclr_tpu.obs.telemetry import Telemetry
@@ -257,6 +258,12 @@ def run_supervised(cfg: Config) -> dict:
         telemetry=telemetry,
         events=events,
     )
+    # step anomaly detection (obs/anomaly.py): slow-step classifier + stall
+    # watchdog + rate-limited auto-trace, host clock reads only
+    detector = (
+        maybe_detector(cfg, save_dir, telemetry=telemetry, events=events)
+        if is_logging_host() else None
+    )
     events.emit(
         "run_start", entry="supervised", epochs=epochs,
         steps_per_epoch=steps_per_epoch, global_batch=global_batch,
@@ -355,6 +362,9 @@ def run_supervised(cfg: Config) -> dict:
                 train_metrics = {k: v[-1] for k, v in epoch_metrics.items()}
                 timer.tick(epoch_metrics["loss"])
                 cur_step += steps_per_epoch
+                if detector is not None:
+                    # one tick per epoch: the loop's unit of progress here
+                    detector.tick(cur_step, epoch)
             else:
                 batches = train_iter.batches(epoch)
                 if skip_steps:
@@ -371,9 +381,17 @@ def run_supervised(cfg: Config) -> dict:
                     )
                     timer.tick(train_metrics["loss"])
                     cur_step += 1
+                    if detector is not None:
+                        # BEFORE the beat: the beat is where fault injection
+                        # wedges, and the watchdog must already be armed
+                        detector.tick(cur_step, epoch)
                     guard.beat(cur_step, epoch)
                     if guard.preempt_requested:
                         break
+            if detector is not None:
+                # validation/checkpoint work at the boundary is not a step:
+                # disarm so it can never read as a stall
+                detector.pause()
             if guard.preempt_requested:
                 # land a resumable checkpoint (alongside the untouched best),
                 # then exit 75 via main(); resume restores this newest state
@@ -478,6 +496,8 @@ def run_supervised(cfg: Config) -> dict:
             epoch += 1
     finally:
         guard.restore_signals()
+        if detector is not None:
+            detector.close()
         if exporter is not None:
             exporter.close()
 

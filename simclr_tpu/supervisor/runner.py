@@ -35,7 +35,7 @@ import tempfile
 import threading
 import time
 
-from simclr_tpu.obs.events import EventLog
+from simclr_tpu.obs.events import EventLog, events_path, read_events
 from simclr_tpu.supervisor.guard import EXIT_POISONED, EXIT_PREEMPTED
 from simclr_tpu.supervisor.heartbeat import heartbeat_path, read_heartbeat
 
@@ -228,6 +228,20 @@ def supervise(
         beat = read_heartbeat(hb_path)
         if beat is not None and isinstance(beat.get("telemetry"), dict):
             summary["telemetry"] = beat["telemetry"]
+        # anomaly forensics come from the shared events.jsonl timeline, NOT
+        # the heartbeat snapshot: a wedged child's final heartbeat predates
+        # its stall (the wedge fires before the beat is written), so only
+        # the detector's events carry the truth
+        counts = {"slow_steps": 0, "stalls": 0, "auto_traces": 0}
+        for event in read_events(events_path(save_dir)):
+            kind = event.get("event")
+            if kind == "slow_step":
+                counts["slow_steps"] += 1
+            elif kind == "stall":
+                counts["stalls"] += 1
+            elif kind == "auto_trace":
+                counts["auto_traces"] += 1
+        summary["anomalies"] = counts
         events.emit(
             "outcome", outcome=outcome, exit=exit_code, attempt=attempt,
             resumed=attempt - 1,

@@ -17,8 +17,10 @@ Covers the four tentpole layers plus their contracts:
   merged two-attempt timeline with no duplicated epoch events.
 """
 
+import http.client
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -33,6 +35,7 @@ import simclr_tpu
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(simclr_tpu.__file__)))
 
 from simclr_tpu.obs import metrics as obs_metrics
+from simclr_tpu.obs.anomaly import StepAnomalyDetector, maybe_detector
 from simclr_tpu.obs.events import (
     ENV_ATTEMPT,
     EventLog,
@@ -41,6 +44,8 @@ from simclr_tpu.obs.events import (
 )
 from simclr_tpu.obs.exporter import maybe_start_exporter, start_exporter
 from simclr_tpu.obs.metrics import Histogram
+from simclr_tpu.obs.report import build_report, load_baseline, render_report
+from simclr_tpu.obs.trace import RequestTrace, TraceRecorder, clean_request_id
 from simclr_tpu.utils.ioutil import atomic_append
 
 pytestmark = pytest.mark.obs
@@ -52,7 +57,9 @@ pytestmark = pytest.mark.obs
 
 # Golden /metrics render generated from the PRE-refactor serve/metrics.py
 # (primitives still private to the serve tier) with the exact feed sequence
-# of _feed_serve_metrics below. The shim must reproduce it byte for byte.
+# of _feed_serve_metrics below, extended in place when the serve tier grows
+# a metric (client_disconnects_total rode in with request tracing). The
+# shim must reproduce it byte for byte.
 SERVE_GOLDEN = """\
 # HELP simclr_serve_requests_total Embed requests accepted into the queue
 # TYPE simclr_serve_requests_total counter
@@ -101,6 +108,9 @@ simclr_serve_batch_latency_ms{quantile="0.95"} 4.25
 simclr_serve_batch_latency_ms{quantile="0.99"} 4.25
 simclr_serve_batch_latency_ms_sum 4.25
 simclr_serve_batch_latency_ms_count 1
+# HELP simclr_serve_client_disconnects_total Responses dropped mid-write by a disconnecting client
+# TYPE simclr_serve_client_disconnects_total counter
+simclr_serve_client_disconnects_total 0
 # HELP simclr_serve_avg_batch_fill Mean requests per dispatched batch
 # TYPE simclr_serve_avg_batch_fill gauge
 simclr_serve_avg_batch_fill 2.5
@@ -254,7 +264,8 @@ class TestTelemetry:
         snap = t.snapshot()
         assert set(snap) == {
             "epoch", "step", "loss", "lr", "imgs_per_sec",
-            "imgs_per_sec_per_chip", "mfu", "uptime_s",
+            "imgs_per_sec_per_chip", "mfu", "slow_steps", "stalls",
+            "auto_traces", "uptime_s",
         }
         assert snap["loss"] == 2.5
         assert json.loads(json.dumps(snap)) == snap  # heartbeat-serializable
@@ -268,6 +279,26 @@ class TestTelemetry:
         assert t.checkpoint_save_seconds.count == 1
         assert t.checkpoint_restore_seconds.sum == pytest.approx(0.5)
         assert t.nan_rollbacks.value == 1
+
+    def test_anomaly_counters(self):
+        t = self._make()
+        t.record_slow_step()
+        t.record_slow_step()
+        t.record_stall()
+        t.record_auto_trace()
+        t.record_scrape_disconnect()
+        assert t.anomaly_slow_steps.value == 2
+        assert t.anomaly_stalls.value == 1
+        assert t.auto_traces.value == 1
+        assert t.scrape_disconnects.value == 1
+        text = t.render()
+        assert "simclr_train_anomaly_slow_steps_total 2" in text
+        assert "simclr_train_anomaly_stalls_total 1" in text
+        assert "simclr_train_auto_traces_total 1" in text
+        assert "simclr_train_scrape_disconnects_total 1" in text
+        snap = t.snapshot()
+        assert snap["slow_steps"] == 2.0 and snap["stalls"] == 1.0
+        assert snap["auto_traces"] == 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -342,6 +373,373 @@ class TestEventLog:
 
 
 # ---------------------------------------------------------------------------
+# request tracing (obs/trace.py)
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTrace:
+    def test_clean_request_id(self):
+        assert clean_request_id("req-42") == "req-42"
+        # whitespace and unprintables stripped, never passed through
+        assert clean_request_id("  a b\tc\n ") == "abc"
+        assert len(clean_request_id("x" * 500)) == 128
+        # absent or unusable header -> a fresh generated id
+        assert len(clean_request_id(None)) == 16
+        assert len(clean_request_id("\x00\x01 ")) == 16
+        assert clean_request_id(None) != clean_request_id(None)
+
+    def test_span_math(self):
+        trace = RequestTrace("rid")
+        t0 = trace.t0
+        trace.add("a", t0, t0 + 0.010)
+        trace.add("b", t0 + 0.010, t0 + 0.025)
+        assert trace.total_s() == pytest.approx(0.025)
+        d = trace.to_dict()
+        assert d["request_id"] == "rid"
+        assert d["total_ms"] == pytest.approx(25.0)
+        assert [s["name"] for s in d["spans"]] == ["a", "b"]
+        assert d["spans"][1]["start_ms"] == pytest.approx(10.0)
+        assert d["spans"][1]["dur_ms"] == pytest.approx(15.0)
+
+    def test_span_context_manager(self):
+        trace = RequestTrace()
+        with trace.span("serialize"):
+            pass
+        ((name, start, end),) = trace.spans()
+        assert name == "serialize" and end >= start
+
+
+class TestTraceRecorder:
+    def _trace(self, total_ms, rid=None):
+        trace = RequestTrace(rid)
+        trace.add("work", trace.t0, trace.t0 + total_ms / 1000.0)
+        return trace
+
+    def test_keeps_only_the_slowest_ordered(self):
+        rec = TraceRecorder(capacity=3)
+        for ms in (1, 5, 3, 2, 4):
+            rec.record(self._trace(ms))
+        assert [r["total_ms"] for r in rec.slowest()] == [5.0, 4.0, 3.0]
+
+    def test_deterministic_sampling_into_sidecar(self, tmp_path):
+        path = tmp_path / "requests.jsonl"
+        rec = TraceRecorder(sample_rate=0.5, path=str(path))
+        for i in range(4):
+            rec.record(self._trace(1, rid=f"r{i}"))
+        lines = [json.loads(line) for line in open(path)]
+        # accumulator sampling: rate 0.5 means exactly every 2nd request
+        assert [l["request_id"] for l in lines] == ["r1", "r3"]
+        assert all("time" in l and l["spans"] for l in lines)
+
+    def test_rate_zero_writes_nothing(self, tmp_path):
+        path = tmp_path / "requests.jsonl"
+        TraceRecorder(sample_rate=0.0, path=str(path)).record(self._trace(1))
+        assert not path.exists()
+
+    def test_bad_params_rejected(self):
+        with pytest.raises(ValueError, match="sample_rate"):
+            TraceRecorder(sample_rate=1.5)
+        with pytest.raises(ValueError, match="capacity"):
+            TraceRecorder(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# step anomaly detection (obs/anomaly.py)
+# ---------------------------------------------------------------------------
+
+
+class _AnomalyCounters:
+    """Telemetry duck type recording the anomaly hook calls."""
+
+    def __init__(self):
+        self.slow = self.stall = self.trace = 0
+
+    def record_slow_step(self):
+        self.slow += 1
+
+    def record_stall(self):
+        self.stall += 1
+
+    def record_auto_trace(self):
+        self.trace += 1
+
+
+def _fake_clock(start=100.0):
+    state = {"t": start}
+    return state, (lambda: state["t"])
+
+
+class TestAnomalyDetector:
+    def test_steady_stream_never_flags(self, tmp_path):
+        # sub-percent jitter around a constant step time (MAD ~ 0) must not
+        # flag: the MAD floor absorbs it
+        state, clock = _fake_clock()
+        det = StepAnomalyDetector(str(tmp_path), warmup=4, clock=clock)
+        try:
+            for i in range(50):
+                state["t"] += 0.1 if i % 2 else 0.101
+                assert det.tick(i) is None
+            assert det.slow_steps == 0
+        finally:
+            det.close()
+
+    def test_slow_step_classifies_and_records(self, tmp_path):
+        state, clock = _fake_clock()
+        events = EventLog(str(tmp_path))
+        telem = _AnomalyCounters()
+        det = StepAnomalyDetector(
+            str(tmp_path), warmup=4, events=events, telemetry=telem,
+            clock=clock,
+        )
+        try:
+            for i in range(10):
+                state["t"] += 0.1
+                det.tick(i, epoch=1)
+            assert det.slow_steps == 0
+            state["t"] += 1.0  # 10x the median step time
+            assert det.tick(10, epoch=2) == "slow_step"
+            assert det.slow_steps == 1 and telem.slow == 1
+        finally:
+            det.close()
+        (slow,) = [
+            e for e in read_events(events.path) if e["event"] == "slow_step"
+        ]
+        assert slow["step"] == 10 and slow["epoch"] == 2
+        assert slow["seconds"] == pytest.approx(1.0)
+        assert slow["median_s"] == pytest.approx(0.1)
+        assert slow["threshold_s"] < 1.0
+
+    def test_warmup_grace_swallows_early_outliers(self, tmp_path):
+        # fewer than `warmup` samples (e.g. right after a compile) must never
+        # classify, however extreme the duration
+        state, clock = _fake_clock()
+        det = StepAnomalyDetector(str(tmp_path), warmup=8, clock=clock)
+        try:
+            for i in range(4):
+                state["t"] += 0.1
+                det.tick(i)
+            state["t"] += 50.0
+            assert det.tick(4) is None and det.slow_steps == 0
+        finally:
+            det.close()
+
+    def test_stall_watchdog_fires_while_loop_is_stuck(self, tmp_path):
+        events = EventLog(str(tmp_path))
+        telem = _AnomalyCounters()
+        captured = []
+        det = StepAnomalyDetector(
+            str(tmp_path), warmup=2, stall_min_s=0.1, stall_factor=2.0,
+            auto_trace=True, auto_trace_ms=10.0, auto_trace_cooldown_s=0.0,
+            events=events, telemetry=telem,
+            capture_fn=lambda d, s: captured.append((d, s)),
+        )
+        try:
+            for i in range(4):
+                det.tick(i, epoch=1)
+                time.sleep(0.02)
+            # go silent: the watchdog thread must report the stall itself
+            deadline = time.monotonic() + 10
+            while det.stalls == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert det.stalls == 1 and telem.stall == 1
+            deadline = time.monotonic() + 10
+            while det.auto_traces == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert det.auto_traces == 1 and telem.trace == 1
+            # fire-once-per-arm: continued silence adds no second stall
+            time.sleep(0.3)
+            assert det.stalls == 1
+        finally:
+            det.close()
+        stall_events = [
+            e for e in read_events(events.path) if e["event"] == "stall"
+        ]
+        assert stall_events and stall_events[0]["silence_s"] > 0
+        (trace_dir, seconds) = captured[0]
+        assert seconds == pytest.approx(0.01)
+        assert os.path.isdir(trace_dir)
+        assert os.sep + "trace_auto" + os.sep in trace_dir
+        (auto,) = [
+            e for e in read_events(events.path) if e["event"] == "auto_trace"
+        ]
+        assert auto["reason"] == "stall" and auto["trace_dir"] == trace_dir
+
+    def test_auto_trace_budget_and_failure_are_contained(self, tmp_path):
+        state, clock = _fake_clock()
+
+        def failing_capture(d, s):
+            raise RuntimeError("profiler busy")
+
+        det = StepAnomalyDetector(
+            str(tmp_path), warmup=2, auto_trace=True, auto_trace_max=1,
+            auto_trace_cooldown_s=0.0, capture_fn=failing_capture,
+            clock=clock,
+        )
+        try:
+            for i in range(6):
+                state["t"] += 0.1
+                det.tick(i)
+            state["t"] += 5.0
+            assert det.tick(6) == "slow_step"  # starts the capture thread
+            deadline = time.monotonic() + 10
+            while det._traces_started == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            # a failed capture spends the budget but counts nothing
+            assert det.auto_traces == 0 and det._traces_started == 1
+            state["t"] += 5.0
+            det.tick(7)  # second anomaly: budget of 1 already spent
+            assert det._traces_started == 1
+        finally:
+            det.close()
+
+    def test_pause_prevents_stall_and_gap_sampling(self, tmp_path):
+        det = StepAnomalyDetector(
+            str(tmp_path), warmup=2, stall_min_s=0.1, stall_factor=2.0
+        )
+        try:
+            for i in range(4):
+                det.tick(i)
+                time.sleep(0.02)
+            det.pause()  # epoch-boundary work: probe / checkpoint I/O
+            time.sleep(0.4)
+            assert det.stalls == 0
+            n = len(det._samples)
+            det.tick(5)  # re-anchors without sampling the paused gap
+            assert len(det._samples) == n
+        finally:
+            det.close()
+
+    def test_maybe_detector_config_gate(self, tmp_path):
+        from simclr_tpu.config import load_config
+
+        cfg = load_config("config", overrides=["telemetry.anomaly=false"])
+        assert maybe_detector(cfg, str(tmp_path)) is None
+        cfg = load_config(
+            "config",
+            overrides=[
+                "telemetry.anomaly_warmup=3", "telemetry.stall_min_s=7.5"
+            ],
+        )
+        det = maybe_detector(cfg, str(tmp_path))
+        try:
+            assert det is not None
+            assert det.warmup == 3 and det.stall_min_s == 7.5
+        finally:
+            det.close()
+
+
+# ---------------------------------------------------------------------------
+# run reports (obs/report.py)
+# ---------------------------------------------------------------------------
+
+
+class TestRunReport:
+    def _run_dir(self, tmp_path):
+        """Synthetic two-attempt run: attempt 1 stalls and is killed hung,
+        attempt 2 finishes clean; final heartbeat carries telemetry."""
+        from simclr_tpu.supervisor.heartbeat import (
+            heartbeat_path,
+            write_heartbeat,
+        )
+
+        run = tmp_path / "run"
+        run.mkdir(exist_ok=True)
+        log = EventLog(str(run))
+        log.emit("run_start", epochs=3)
+        log.emit("epoch", epoch=1)
+        log.emit("checkpoint", epoch=1)
+        log.emit("slow_step", step=3, epoch=2, seconds=1.0)
+        log.emit("stall", step=4, epoch=2, silence_s=3.0)
+        log.emit("auto_trace", reason="stall", trace_dir="t")
+        log.emit("child_exit", attempt=1, exit=-9, hung=True)
+        log.emit("restart", attempt=2)
+        log.emit("run_start", attempt=2, epochs=3)
+        log.emit("epoch", epoch=2, attempt=2)
+        log.emit("epoch", epoch=3, attempt=2)
+        write_heartbeat(
+            heartbeat_path(str(run)), step=6, epoch=3,
+            telemetry={"imgs_per_sec_per_chip": 80.0},
+        )
+        with open(run / "supervisor_summary.json", "w") as f:
+            json.dump({"outcome": "clean", "exit": 0, "resumed": 1}, f)
+        return str(run)
+
+    def _baseline(self, tmp_path, value=100.0, shape="payload"):
+        path = tmp_path / f"BENCH_{shape}.json"
+        if shape == "payload":
+            payload = {
+                "captured_at": "2026-01-01",
+                "payload": {
+                    "metric": "pretrain_imgs_per_sec_per_chip",
+                    "value": value,
+                },
+            }
+        else:
+            payload = {
+                "n": 1,
+                "parsed": {
+                    "metric": "pretrain_imgs_per_sec_per_chip",
+                    "value": value,
+                },
+            }
+        path.write_text(json.dumps(payload))
+        return str(path)
+
+    def test_per_attempt_counts_and_stalled_attempts(self, tmp_path):
+        report = build_report(self._run_dir(tmp_path))
+        a1 = report["attempts"]["1"]
+        assert a1["epochs"] == 1 and a1["checkpoints"] == 1
+        assert a1["slow_steps"] == 1 and a1["stalls"] == 1
+        assert a1["auto_traces"] == 1
+        assert a1["exit"] == -9 and a1["hung"] is True
+        assert report["attempts"]["2"]["epochs"] == 2
+        assert report["stalled_attempts"] == [1]
+        assert report["outcome"] == "clean"
+        assert report["verdict"] == "NO_BASELINE"  # no --baseline given
+
+    def test_verdict_ok_and_regression(self, tmp_path):
+        run = self._run_dir(tmp_path)
+        base = self._baseline(tmp_path, value=100.0)
+        ok = build_report(run, baseline_path=base, threshold=0.8)
+        assert ok["verdict"] == "OK"
+        assert ok["throughput_ratio"] == pytest.approx(0.8)
+        bad = build_report(run, baseline_path=base, threshold=0.9)
+        assert bad["verdict"] == "REGRESSION"
+
+    def test_baseline_shapes_and_failures(self, tmp_path):
+        assert load_baseline(self._baseline(tmp_path)) == 100.0
+        assert load_baseline(self._baseline(tmp_path, shape="parsed")) == 100.0
+        assert load_baseline(str(tmp_path / "nope.json")) is None
+        dead = tmp_path / "dead_probe.json"
+        dead.write_text(json.dumps({"n": 3, "parsed": None}))
+        assert load_baseline(str(dead)) is None
+
+    def test_empty_run_dir_is_no_data(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        report = build_report(
+            str(empty), baseline_path=self._baseline(tmp_path)
+        )
+        assert report["verdict"] == "NO_DATA"
+
+    def test_cli_prints_greppable_verdict_line(self, tmp_path, capsys):
+        from simclr_tpu.obs import report as report_mod
+
+        run = self._run_dir(tmp_path)
+        out_json = tmp_path / "report.json"
+        rc = report_mod.main(
+            [run, "--baseline", self._baseline(tmp_path),
+             "--json", str(out_json)]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "stalled attempts: 1" in out
+        # the verdict is the LAST line and greppable (tpu_watch contract)
+        assert out.strip().splitlines()[-1].startswith("run_report verdict: OK")
+        assert json.load(open(out_json))["verdict"] == "OK"
+
+
+# ---------------------------------------------------------------------------
 # HTTP exporter
 # ---------------------------------------------------------------------------
 
@@ -354,6 +752,20 @@ class _StubTelemetry:
 
     def snapshot(self):
         return {"epoch": 7.0, "imgs_per_sec": 123.0}
+
+
+class _DisconnectingScrapeTelemetry(_StubTelemetry):
+    """render() far larger than the socket buffer, so a client that hangs
+    up unread forces the server's write to fail mid-stream."""
+
+    def __init__(self):
+        self.disconnects = 0
+
+    def render(self):
+        return "# HELP x y\n# TYPE x gauge\nx 1\n" + "#" * (4 << 20) + "\n"
+
+    def record_scrape_disconnect(self):
+        self.disconnects += 1
 
 
 def _get(url, timeout=10):
@@ -428,6 +840,44 @@ class TestExporter:
         assert trace_dir.startswith(str(tmp_path))
         assert os.listdir(trace_dir), "trace capture left an empty directory"
 
+    def test_close_removes_ready_file(self, tmp_path):
+        # a stale ready file after close would point monitors at a dead
+        # (or recycled) port
+        ready = tmp_path / "gone.json"
+        exp = start_exporter(
+            _StubTelemetry(), str(tmp_path), trace_max_ms=5000,
+            ready_file=str(ready),
+        )
+        assert ready.exists()
+        exp.close()
+        assert not ready.exists()
+
+    def test_scrape_disconnect_is_counted_not_fatal(self, tmp_path):
+        import socket
+        import struct
+
+        telem = _DisconnectingScrapeTelemetry()
+        exp = start_exporter(telem, str(tmp_path), trace_max_ms=5000,
+                             ready_file=str(tmp_path / "r.json"))
+        try:
+            s = socket.create_connection(("127.0.0.1", exp.port), timeout=10)
+            s.sendall(b"GET /metrics HTTP/1.1\r\nHost: t\r\n\r\n")
+            # RST immediately with megabytes still unread: the server's
+            # write must fail mid-body
+            s.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+            )
+            s.close()
+            deadline = time.monotonic() + 10
+            while telem.disconnects == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert telem.disconnects >= 1
+            # the exporter survived and still answers
+            status, _, _ = _get(f"http://127.0.0.1:{exp.port}/healthz")
+            assert status == 200
+        finally:
+            exp.close()
+
     def test_maybe_start_exporter_port_semantics(self, tmp_path):
         from simclr_tpu.config import load_config
 
@@ -462,6 +912,17 @@ class TestConfigValidation:
             ("telemetry.trace_max_ms=0", "(0, 600000]"),
             ("telemetry.trace_max_ms=900000", "(0, 600000]"),
             ("telemetry.events=maybe", "(true|false)"),
+            ("telemetry.anomaly=maybe", "(true|false)"),
+            ("telemetry.anomaly_warmup=1", "[2, 10000]"),
+            ("telemetry.anomaly_warmup=2.5", "[2, 10000]"),
+            ("telemetry.slow_step_factor=0", "[1, 1000]"),
+            ("telemetry.stall_factor=0", "[1, 1000]"),
+            ("telemetry.stall_min_s=0", "(0, 3600]"),
+            ("telemetry.auto_trace=maybe", "(true|false)"),
+            ("telemetry.auto_trace_ms=100000", "(0, 60000]"),
+            ("telemetry.auto_trace_cooldown_s=-1", "[0, 86400]"),
+            ("telemetry.auto_trace_max=0", "[1, 100]"),
+            ("telemetry.auto_trace_max=101", "[1, 100]"),
         ],
     )
     def test_bad_knobs_name_the_valid_range(self, override, expected_range):
@@ -471,6 +932,22 @@ class TestConfigValidation:
         with pytest.raises(ConfigError, match="telemetry\\.") as err:
             check_telemetry_conf(cfg)
         assert expected_range in str(err.value)
+
+    @pytest.mark.parametrize(
+        "override, expected",
+        [
+            ("serve.trace_sample_rate=1.5", "[0.0, 1.0]"),
+            ("serve.trace_sample_rate=-0.25", "[0.0, 1.0]"),
+            ("serve.requests_log=7", "path string or null"),
+        ],
+    )
+    def test_serve_trace_knobs_name_the_valid_range(self, override, expected):
+        from simclr_tpu.config import ConfigError, check_serve_conf, load_config
+
+        cfg = load_config("serve", overrides=[override])
+        with pytest.raises(ConfigError, match="serve\\.") as err:
+            check_serve_conf(cfg)
+        assert expected in str(err.value)
 
     def test_both_entry_point_checks_cover_telemetry(self):
         from simclr_tpu.config import (
@@ -545,7 +1022,10 @@ class TestEndToEnd:
         EXACTLY as many ``synchronize`` device fences as the run with no
         exporter at all. (Sync points are fixed loop landmarks, so the count
         is deterministic per config.)"""
-        base = SYNTH + ["parameter.epochs=2"]
+        # anomaly detection is ON by default; warmup=2 makes sure the
+        # median/MAD classification path actually runs inside this short
+        # run, so the zero-sync proof covers the detector too
+        base = SYNTH + ["parameter.epochs=2", "telemetry.anomaly_warmup=2"]
         _, baseline_syncs = _run_pretrain_counting_syncs(
             base + [f"experiment.save_dir={tmp_path / 'plain'}"], monkeypatch
         )
@@ -635,3 +1115,98 @@ class TestEndToEnd:
         # wall-clock ordering holds across the attempt boundary
         times = [e["time"] for e in events]
         assert times == sorted(times)
+
+    def test_wedged_run_yields_stall_autotrace_and_report(self, tmp_path):
+        """Flight-recorder acceptance: a host loop that silently wedges
+        (fault injection at beat 6, the last step) must — with no operator
+        anywhere — produce a ``stall`` event from the watchdog thread, show
+        the incremented counter on a live ``/metrics`` scrape while still
+        wedged, capture an automatic profiler trace, surface the anomaly
+        counts in ``supervisor_summary.json`` after the supervisor kills and
+        resumes it, and have the post-mortem report name the stalled
+        attempt."""
+        from simclr_tpu.supervisor.faults import ENV_WEDGE
+
+        save_dir = str(tmp_path / "wedged")
+        ready = tmp_path / "ready.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **{ENV_WEDGE: "6"})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "simclr_tpu.supervisor", "--", "pretrain",
+             *SYNTH, "parameter.epochs=3",
+             "supervisor.backoff_base_s=0.05",
+             # the stall watchdog (deadline ~2x the ~6s CPU step median)
+             # must beat the supervisor's hang kill by a wide margin, and
+             # the floor must leave room for a resumed attempt's first
+             # post-compile step gap (~13s on CPU: the step-5 beat lands
+             # right after compile, before step 5 even executes)
+             "supervisor.heartbeat_min_timeout_s=30",
+             "supervisor.heartbeat_timeout_factor=10",
+             "telemetry.anomaly_warmup=2",
+             "telemetry.stall_min_s=1.0",
+             "telemetry.stall_factor=2.0",
+             "telemetry.auto_trace=true",
+             "telemetry.auto_trace_ms=200",
+             "telemetry.auto_trace_cooldown_s=0",
+             f"telemetry.ready_file={ready}",
+             f"experiment.save_dir={save_dir}"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        # live scrape: the stall counter must go positive while the host
+        # loop is still stuck (the exporter thread keeps serving)
+        stall_scraped = False
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                port = json.load(open(ready))["port"]
+                _, _, body = _get(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                )
+                if re.search(r"simclr_train_anomaly_stalls_total [1-9]", body):
+                    stall_scraped = True
+                    break
+            except (OSError, ValueError, KeyError, urllib.error.URLError,
+                    http.client.HTTPException):
+                # the exporter can vanish mid-response when the supervisor
+                # SIGKILLs the wedged attempt — keep polling
+                pass
+            time.sleep(0.2)
+        try:
+            stdout, stderr = proc.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+        assert proc.returncode == 0, stderr[-2000:]
+        assert stall_scraped, "stall counter never appeared on a live scrape"
+
+        summary = json.loads(
+            [l for l in stdout.splitlines() if l.startswith("{")][-1]
+        )
+        assert summary["outcome"] == "clean"
+        assert summary["anomalies"]["stalls"] >= 1
+        assert summary["anomalies"]["auto_traces"] >= 1
+
+        events = read_events(events_path(save_dir))
+        stalls = [e for e in events if e["event"] == "stall"]
+        assert stalls and stalls[0]["attempt"] == 1
+        traces = [e for e in events if e["event"] == "auto_trace"]
+        assert traces, "no automatic capture was recorded"
+        trace_dir = traces[0]["trace_dir"]
+        assert os.sep + "trace_auto" + os.sep in trace_dir
+        assert os.path.isdir(trace_dir) and os.listdir(trace_dir), (
+            "auto-trace directory is missing or empty"
+        )
+
+        # the post-mortem names the stalled attempt and judges throughput
+        baseline = tmp_path / "BENCH_FAKE.json"
+        baseline.write_text(json.dumps({
+            "payload": {
+                "metric": "pretrain_imgs_per_sec_per_chip", "value": 1e-9
+            }
+        }))
+        report = build_report(
+            save_dir, baseline_path=str(baseline), threshold=0.05
+        )
+        assert 1 in report["stalled_attempts"]
+        text = render_report(report)
+        assert text.splitlines()[-1].startswith("run_report verdict: OK")

@@ -65,11 +65,14 @@ class LiveServer:
         )
         self.thread.start()
 
-    def request(self, method, path, body=None, timeout=30):
+    def request(self, method, path, body=None, timeout=30, headers=None):
         conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=timeout)
         try:
             payload = None if body is None else json.dumps(body).encode()
-            conn.request(method, path, payload, {"Content-Type": "application/json"})
+            hdrs = {"Content-Type": "application/json"}
+            if headers:
+                hdrs.update(headers)
+            conn.request(method, path, payload, hdrs)
             r = conn.getresponse()
             return r.status, r.read(), dict(r.getheaders())
         finally:
@@ -231,7 +234,7 @@ class TestErrorStatuses:
         from simclr_tpu.serve.batcher import BackpressureError
 
         class FullQueue:
-            def submit(self, images):
+            def submit(self, images, trace=None):
                 raise BackpressureError("request queue full (test)")
 
         real = live.server.batcher
@@ -254,6 +257,85 @@ class TestErrorStatuses:
             assert status == 503
         finally:
             live.server.draining.clear()
+
+
+class TestRequestTracing:
+    def test_client_request_id_echoed(self, live):
+        status, _, headers = live.request(
+            "POST", "/v1/embed", {"instances": random_images(1).tolist()},
+            headers={"X-Request-Id": "my-req-1"},
+        )
+        assert status == 200
+        assert headers["X-Request-Id"] == "my-req-1"
+
+    def test_generated_request_id_when_absent(self, live):
+        ids = set()
+        for _ in range(2):
+            status, _, headers = live.request(
+                "POST", "/v1/embed", {"instances": random_images(1).tolist()}
+            )
+            assert status == 200
+            rid = headers["X-Request-Id"]
+            assert len(rid) >= 8
+            ids.add(rid)
+        assert len(ids) == 2, "generated ids must differ across requests"
+
+    def test_request_id_echoed_on_errors(self, live):
+        # a failed request is exactly the one the client wants to report by
+        # id — error responses must carry the header too
+        status, _, headers = live.request(
+            "POST", "/v1/embed", {"wrong": []},
+            headers={"X-Request-Id": "err-1"},
+        )
+        assert status == 400
+        assert headers["X-Request-Id"] == "err-1"
+
+    def test_debug_slow_serves_span_breakdown(self, live):
+        for i in range(3):
+            status, _ = live.embed(random_images(2, seed=i))
+            assert status == 200
+        status, body, _ = live.request("GET", "/debug/slow")
+        assert status == 200
+        slowest = json.loads(body)["slowest"]
+        assert len(slowest) == 3
+        totals = [r["total_ms"] for r in slowest]
+        assert totals == sorted(totals, reverse=True)
+        # every stage of the request's life is accounted for
+        names = {s["name"] for s in slowest[0]["spans"]}
+        assert {
+            "queue_wait", "coalesce", "pad", "device_compute", "serialize"
+        } <= names
+        assert all(r["request_id"] for r in slowest)
+
+    def test_requests_log_sidecar_sampling(self, tmp_path):
+        sidecar = tmp_path / "requests.jsonl"
+        model = TinyContrastive(bn_cross_replica_axis=None)
+        variables = jax.tree.map(
+            np.asarray, model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+        )
+        metrics = ServeMetrics()
+        engine = EmbedEngine(
+            model, variables, max_batch=MAX_BATCH, metrics=metrics
+        )
+        server, batcher = start_server(
+            serve_cfg(**{
+                "serve.trace_sample_rate": 1.0,
+                "serve.requests_log": str(sidecar),
+            }),
+            engine=engine, metrics=metrics,
+        )
+        ls = LiveServer(server, batcher, engine, metrics)
+        try:
+            for i in range(2):
+                status, _ = ls.embed(random_images(1, seed=i))
+                assert status == 200
+            lines = [json.loads(line) for line in open(sidecar)]
+            assert len(lines) == 2
+            assert all(l["total_ms"] > 0 and l["spans"] for l in lines)
+        finally:
+            shutdown_gracefully(server, drain_timeout_s=10)
+            ls.thread.join(timeout=10)
+            server.server_close()
 
 
 class TestGracefulShutdown:

@@ -21,7 +21,7 @@ WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "remat2048", "explore1024", "explore512",
-    "supervisor_smoke", "obs_smoke",
+    "supervisor_smoke", "obs_smoke", "run_report",
 )
 
 
@@ -79,6 +79,11 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         # from the printed /metrics catalog (rc 0 alone proves nothing)
         'case "$*" in *obs_smoke.py*) '
         "echo 'simclr_train_imgs_per_sec 12345.6';; esac",
+        # the run_report stage greps for a COMPUTED verdict (OK|REGRESSION):
+        # a NO_DATA/NO_BASELINE report exits 0 but proves nothing
+        'case "$*" in *simclr_tpu.obs.report*) '
+        "echo 'run_report verdict: OK (imgs/s/chip measured=100.0 "
+        "baseline=120.0 ratio=0.8333 threshold=0.05)';; esac",
         # sleep first: the stage's freshness check compares whole-second
         # mtimes, and consecutive tests touch the same file
         'case "$*" in *bench.py*) sleep 1; touch "$BENCH_CAPTURE_PATH";; esac',
@@ -205,6 +210,24 @@ def test_obs_marker_requires_live_throughput_gauge(tmp_path):
     assert "obs_smoke" not in _done(state)
     assert (state / "obs_smoke.fails").exists()
     assert "stage obs_smoke FAILED" in log.read_text()
+
+
+def test_run_report_marker_requires_computed_verdict(tmp_path):
+    """The report CLI exits 0 whenever it produced ANY report — only a
+    verdict line with an actually-computed throughput ratio (OK or
+    REGRESSION) counts as collected evidence; NO_DATA means the smoke run
+    left nothing to judge."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        "run_report verdict: OK (imgs/s/chip measured=100.0 "
+        "baseline=120.0 ratio=0.8333 threshold=0.05)",
+        "run_report verdict: NO_DATA (imgs/s/chip measured=None "
+        "baseline=None ratio=None threshold=0.05)"))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "run_report" not in _done(state)
+    assert (state / "run_report.fails").exists()
+    assert "stage run_report FAILED" in log.read_text()
 
 
 def test_repeat_offender_is_deferred_not_skipped(tmp_path):

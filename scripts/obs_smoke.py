@@ -30,6 +30,10 @@ import urllib.error
 import urllib.request
 
 GAUGE = "simclr_train_imgs_per_sec"
+# live HBM accounting (obs/device.py): at least one gauge with this prefix
+# must appear in the final payload on EVERY backend (the high-watermark
+# gauge renders even when the allocator reports no stats)
+HBM_PREFIX = "simclr_train_hbm_"
 # SIGTERM lands the preempt path: EXIT_PREEMPTED (75) or 0 if the run had
 # already finished — both are clean shutdowns (docs/FAULT_TOLERANCE.md)
 OK_EXITS = (0, 75)
@@ -117,6 +121,16 @@ def main(argv: list[str] | None = None) -> int:
             time.sleep(1.0)
         if not ok:
             print(f"obs_smoke: {GAUGE} never went positive within budget")
+            return 1
+
+        # 2b. live HBM accounting must be present on every backend: the
+        # high-watermark gauge renders unconditionally (obs/device.py), so
+        # a payload with no simclr_train_hbm_ line means the DeviceMonitor
+        # never attached
+        if not any(
+            line.startswith(HBM_PREFIX) for line in metrics_text.splitlines()
+        ):
+            print(f"obs_smoke: no {HBM_PREFIX}* gauge in /metrics")
             return 1
 
         # 3. healthz carries the same snapshot that rides heartbeat.json

@@ -51,7 +51,7 @@ MAX_STAGE_FAILS=3
 # PERF.md's compressed-collectives rows are pending on it), then the
 # remaining step matrices, and last the supervisor kill/resume smoke
 # (fault tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -233,6 +233,24 @@ run_stage() {
             cat "$out" >> "$LOG"
             if [ "$rc" -eq 0 ]; then
                 grep -Eq '^simclr_train_imgs_per_sec [0-9.eE+-]+$' "$out"
+                rc=$?
+            fi ;;
+        compile_audit)
+            # compile-side observability e2e ON the chip (obs/compile.py,
+            # obs/device.py): its own obs_smoke run whose done marker
+            # requires the compile sentry's evidence in the scraped
+            # /metrics catalog — a positive compiles counter AND a zero
+            # recompile-alarm counter (a steady-shape training loop that
+            # alarms means the sentry or the loop is broken).
+            out="$STATE/compile_audit.out"
+            rm -rf /tmp/tpu_watch_compile_audit
+            run_locked "$(stage_timeout 1200)" python scripts/obs_smoke.py \
+                --save-dir /tmp/tpu_watch_compile_audit > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -Eq '^simclr_train_compiles_total [1-9][0-9]*$' "$out" \
+                    && grep -Eq '^simclr_train_recompile_alarms_total 0$' "$out"
                 rc=$?
             fi ;;
         run_report)

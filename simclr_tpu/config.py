@@ -535,6 +535,17 @@ def _check_telemetry_conf(cfg: Config) -> None:
         "telemetry.auto_trace_max must be an int in [1, 100] automatic "
         f"captures per attempt, got {auto_trace_max!r}",
     )
+    compile_sentry = cfg.select("telemetry.compile_sentry", True)
+    _require(
+        isinstance(compile_sentry, bool),
+        f"telemetry.compile_sentry must be a boolean (true|false), "
+        f"got {compile_sentry!r}",
+    )
+    hbm = cfg.select("telemetry.hbm", True)
+    _require(
+        isinstance(hbm, bool),
+        f"telemetry.hbm must be a boolean (true|false), got {hbm!r}",
+    )
 
 
 def check_supervisor_conf(cfg: Config) -> None:

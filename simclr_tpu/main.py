@@ -39,6 +39,8 @@ from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import ContrastiveModel
 from simclr_tpu.obs.anomaly import maybe_detector
+from simclr_tpu.obs.compile import maybe_sentry
+from simclr_tpu.obs.device import maybe_dump_oom_profile, maybe_monitor
 from simclr_tpu.obs.events import EventLog
 from simclr_tpu.obs.exporter import maybe_start_exporter
 from simclr_tpu.obs.telemetry import Telemetry
@@ -198,6 +200,14 @@ def run_pretrain(cfg: Config) -> dict:
         maybe_detector(cfg, save_dir, telemetry=telemetry, events=events)
         if is_logging_host() else None
     )
+    # compile sentry (obs/compile.py): every lower/compile of the step
+    # functions is timed, fingerprinted, and cost-analyzed; a post-warmup
+    # recompile raises the alarm and reuses the detector's rate-limited
+    # auto-trace
+    sentry = (
+        maybe_sentry(cfg, telemetry=telemetry, events=events, detector=detector)
+        if is_logging_host() else None
+    )
     events.emit(
         "run_start", entry="pretrain", epochs=epochs,
         steps_per_epoch=steps_per_epoch, global_batch=global_batch,
@@ -240,6 +250,9 @@ def run_pretrain(cfg: Config) -> dict:
         # all-reduce — exact | bf16 | int8 (parallel/compress.py,
         # docs/PERF.md §"Compressed collectives")
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+        # obs/compile.py recompile sentry: the builders route the jitted
+        # step through an instrumented AOT lower/compile path when set
+        sentry=sentry,
     )
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     if epoch_compile and skip_steps:
@@ -259,6 +272,9 @@ def run_pretrain(cfg: Config) -> dict:
     residency = str(cfg.select("runtime.dataset_residency", "replicated"))
     put_dataset = put_replicated if residency == "replicated" else put_row_sharded
     data_shard = batch_sharding(mesh)
+    # analytic per-chip resident dataset bytes from the epoch-compile
+    # preflight; the DeviceMonitor reconciles it against measured live HBM
+    resident_bytes = None
     if n_model > 1:
         # tensor-parallel projection head over the model axis (parallel/tp.py).
         # Support matrix: docs/PERF.md §"Tensor-parallel support matrix"
@@ -280,7 +296,7 @@ def run_pretrain(cfg: Config) -> dict:
                 "(see docs/PERF.md, tensor-parallel support matrix)"
             )
         if epoch_compile:
-            check_epoch_compile_preconditions(
+            resident_bytes = check_epoch_compile_preconditions(
                 len(dataset), global_batch, cfg.select("experiment.profile_dir"),
                 dataset_bytes=dataset.images.nbytes,
                 n_data_shards=n_data,
@@ -294,6 +310,13 @@ def run_pretrain(cfg: Config) -> dict:
                 residency=residency,
                 grad_allreduce=step_kwargs["grad_allreduce"],
             )
+            if sentry is not None:
+                # the TP builders predate the sentry kwarg; wrap at the
+                # call site with the same epoch-scan step extractor
+                epoch_fn = sentry.watch(
+                    epoch_fn, "pretrain_epoch",
+                    steps_from_args=lambda args: int(args[2].shape[0]),
+                )
             images_all = put_dataset(dataset.images, mesh)
             iterator = None
         else:
@@ -304,12 +327,14 @@ def run_pretrain(cfg: Config) -> dict:
                 remat=step_kwargs["remat"],
                 grad_allreduce=step_kwargs["grad_allreduce"],
             )
+            if sentry is not None:
+                step_fn = sentry.watch(step_fn, "pretrain_step")
             iterator = EpochIterator(
                 dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
                 gather_threads=int(cfg.parameter.num_workers),
             )
     elif epoch_compile:
-        check_epoch_compile_preconditions(
+        resident_bytes = check_epoch_compile_preconditions(
             len(dataset), global_batch, cfg.select("experiment.profile_dir"),
             dataset_bytes=dataset.images.nbytes,
             n_data_shards=n_data,
@@ -330,6 +355,17 @@ def run_pretrain(cfg: Config) -> dict:
             dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
             gather_threads=int(cfg.parameter.num_workers),
         )
+
+    # live HBM accounting (obs/device.py): per-device memory_stats gauges
+    # sampled at scrape time from the exporter thread — host-side allocator
+    # queries, zero device syncs — reconciled against the preflight's
+    # analytic footprint when epoch_compile computed one
+    monitor = (
+        maybe_monitor(cfg, events=events, expected_resident_bytes=resident_bytes)
+        if is_logging_host() else None
+    )
+    if monitor is not None:
+        telemetry.attach_device_monitor(monitor)
 
     if is_logging_host():
         os.makedirs(save_dir, exist_ok=True)
@@ -614,6 +650,13 @@ def run_pretrain(cfg: Config) -> dict:
                 }
             )
             epoch += 1
+    except Exception as exc:
+        # an allocator RESOURCE_EXHAUSTED leaves its forensic behind —
+        # device memory profile + oom event — before the error propagates;
+        # any other exception passes through untouched
+        if is_logging_host():
+            maybe_dump_oom_profile(save_dir, exc, events=events)
+        raise
     finally:
         guard.restore_signals()
         if detector is not None:

@@ -50,6 +50,9 @@ _COUNTED_EVENTS = {
     "auto_trace": "auto_traces",
     "nan_rollback": "nan_rollbacks",
     "preempt": "preempts",
+    "compile": "compiles",
+    "recompile_alarm": "recompile_alarms",
+    "oom": "ooms",
 }
 
 
@@ -108,6 +111,8 @@ def build_report(
                 "hung": False,
                 "first_time": None,
                 "last_time": None,
+                "compile_seconds": 0.0,
+                "hbm_peak_per_device": {},
             },
         )
         kind = event.get("event")
@@ -116,6 +121,21 @@ def build_report(
         elif kind == "child_exit":
             entry["exit"] = event.get("exit")
             entry["hung"] = bool(event.get("hung"))
+        if kind == "compile":
+            seconds = event.get("seconds")
+            if isinstance(seconds, (int, float)):
+                entry["compile_seconds"] = round(
+                    entry["compile_seconds"] + seconds, 6
+                )
+        elif kind == "hbm":
+            per_device = event.get("per_device")
+            if isinstance(per_device, dict):
+                peaks = entry["hbm_peak_per_device"]
+                for device, peak in per_device.items():
+                    if isinstance(peak, (int, float)):
+                        peaks[str(device)] = max(
+                            peaks.get(str(device), 0), int(peak)
+                        )
         when = event.get("time")
         if isinstance(when, (int, float)):
             if entry["first_time"] is None:
@@ -200,6 +220,23 @@ def render_report(report: dict) -> str:
             f"nan_rollbacks={entry['nan_rollbacks']} "
             f"preempts={entry['preempts']}{exit_part}{hung_part}"
         )
+        if entry["compiles"] or entry["recompile_alarms"] or entry["ooms"]:
+            alarm_part = (
+                f" RECOMPILE_ALARMS={entry['recompile_alarms']}"
+                if entry["recompile_alarms"] else ""
+            )
+            oom_part = f" OOMS={entry['ooms']}" if entry["ooms"] else ""
+            lines.append(
+                f"  compiles: {entry['compiles']} "
+                f"({entry['compile_seconds']:.2f}s total)"
+                f"{alarm_part}{oom_part}"
+            )
+        if entry["hbm_peak_per_device"]:
+            peaks = " ".join(
+                f"dev{device}={peak / 2 ** 30:.2f}GiB"
+                for device, peak in sorted(entry["hbm_peak_per_device"].items())
+            )
+            lines.append(f"  hbm peak: {peaks}")
     if report["stalled_attempts"]:
         lines.append(
             "stalled attempts: "

@@ -154,7 +154,26 @@ class Telemetry:
         self.scrape_disconnects = Counter(
             "simclr_train_scrape_disconnects_total",
             "Scrape responses dropped mid-write by a disconnecting peer")
+        self.compiles = Counter(
+            "simclr_train_compiles_total",
+            "XLA compilations recorded by the compile sentry (obs/compile.py)")
+        self.compile_seconds = Summary(
+            "simclr_train_compile_seconds",
+            "Wall time of each recorded XLA lower+compile")
+        self.recompile_alarms = Counter(
+            "simclr_train_recompile_alarms_total",
+            "Post-warmup recompilations of a watched step function — the "
+            "silent TPU perf killer")
+        self.mfu_xla_drift = Gauge(
+            "simclr_train_mfu_roofline_xla_drift",
+            "Fractional drift of the roofline FLOP model feeding the live "
+            "MFU gauge vs XLA's analytic cost for the step executable "
+            "(roofline/xla - 1; 0 until a step cost is recorded)")
         self.grad_allreduce_mode = str(grad_allreduce)
+        # name -> (flops/step, bytes/step) from the compile sentry, rendered
+        # as labeled per-executable cost gauges
+        self._xla_costs: dict[str, tuple[float, float]] = {}
+        self._device_monitor = None
         if grad_elements:
             from simclr_tpu.parallel.compress import allreduce_wire_bytes
 
@@ -173,9 +192,19 @@ class Telemetry:
             self.checkpoint_save_seconds, self.checkpoint_restore_seconds,
             self.checkpoint_saves, self.nan_rollbacks,
             self.anomaly_slow_steps, self.anomaly_stalls, self.auto_traces,
-            self.scrape_disconnects,
+            self.scrape_disconnects, self.compiles, self.compile_seconds,
+            self.recompile_alarms, self.mfu_xla_drift,
         )
         self._started = time.time()
+
+    def attach_device_monitor(self, monitor) -> None:
+        """Render the DeviceMonitor's HBM gauges with every scrape.
+
+        Sampling happens inside :meth:`render`, i.e. on the exporter's
+        handler thread — host-side ``memory_stats`` queries, zero device
+        syncs (the monitor's contract, see obs/device.py).
+        """
+        self._device_monitor = monitor
 
     # -- update hooks (host floats only; no device values) -----------------
     def observe_epoch(
@@ -234,6 +263,30 @@ class Telemetry:
     def record_scrape_disconnect(self) -> None:
         self.scrape_disconnects.inc()
 
+    def record_compile(self, seconds: float) -> None:
+        self.compiles.inc()
+        self.compile_seconds.observe(float(seconds))
+
+    def record_recompile_alarm(self) -> None:
+        self.recompile_alarms.inc()
+
+    def observe_xla_cost(
+        self, name: str, *, flops_per_step: float = 0.0,
+        bytes_per_step: float = 0.0,
+    ) -> None:
+        """Per-executable analytic cost from the compile sentry.
+
+        When the roofline FLOP model applies (pretrain), the drift gauge
+        reconciles it against XLA's own analytic flops for the same step —
+        the continuous version of the scripts/perf_attrib.py survey.
+        """
+        with self._lock:
+            self._xla_costs[str(name)] = (
+                float(flops_per_step), float(bytes_per_step)
+            )
+        if self.flops_per_step and flops_per_step > 0:
+            self.mfu_xla_drift.set(self.flops_per_step / flops_per_step - 1.0)
+
     # -- read side ----------------------------------------------------------
     def snapshot(self) -> dict:
         """The compact latest-values dict riding on ``heartbeat.json`` (and
@@ -249,6 +302,8 @@ class Telemetry:
             "slow_steps": self.anomaly_slow_steps.value,
             "stalls": self.anomaly_stalls.value,
             "auto_traces": self.auto_traces.value,
+            "compiles": self.compiles.value,
+            "recompile_alarms": self.recompile_alarms.value,
             "uptime_s": round(time.time() - self._started, 3),
         }
 
@@ -262,4 +317,35 @@ class Telemetry:
             "# TYPE simclr_train_grad_allreduce_mode gauge\n"
             f'simclr_train_grad_allreduce_mode{{mode="{self.grad_allreduce_mode}"}} 1\n'
         )
+        with self._lock:
+            costs = dict(self._xla_costs)
+        if costs:
+            flop_lines = "".join(
+                f'simclr_train_xla_cost_flops{{executable="{name}"}} '
+                f"{flops:g}\n"
+                for name, (flops, _) in sorted(costs.items())
+            )
+            byte_lines = "".join(
+                f'simclr_train_xla_cost_bytes_accessed{{executable="{name}"}} '
+                f"{nbytes:g}\n"
+                for name, (_, nbytes) in sorted(costs.items())
+            )
+            parts.append(
+                "# HELP simclr_train_xla_cost_flops XLA analytic flops per "
+                "step of each compiled executable (obs/compile.py)\n"
+                "# TYPE simclr_train_xla_cost_flops gauge\n" + flop_lines
+            )
+            parts.append(
+                "# HELP simclr_train_xla_cost_bytes_accessed XLA analytic "
+                "bytes accessed per step of each compiled executable\n"
+                "# TYPE simclr_train_xla_cost_bytes_accessed gauge\n"
+                + byte_lines
+            )
+        if self._device_monitor is not None:
+            # live HBM sampling happens here, on the scraping thread; a
+            # backend hiccup must never break the whole /metrics payload
+            try:
+                parts.append(self._device_monitor.render())
+            except Exception:
+                pass
         return "".join(parts)

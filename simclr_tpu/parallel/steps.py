@@ -70,16 +70,45 @@ def device_hbm_budget_bytes():
     """Spare-HBM budget for on-device dataset residency, or None if unknown.
 
     ``memory_stats`` is backend-dependent: TPU/GPU report ``bytes_limit``;
-    CPU test meshes report nothing, in which case the preflight skips the
-    capacity check rather than guessing.
+    CPU test meshes report nothing (or raise), in which case the preflight
+    skips the capacity check rather than guessing. All key access goes
+    through the hardened sampler in ``obs/device.py`` — the same one the
+    live HBM gauges use — so a partial or exotic stats payload degrades to
+    "unknown budget", never a KeyError.
     """
+    from simclr_tpu.obs.device import sample_memory_stats
+
     try:
-        stats = jax.local_devices()[0].memory_stats()
+        device = jax.local_devices()[0]
     except Exception:  # pragma: no cover — backend-dependent API
         return None
+    stats = sample_memory_stats(device)
     if not stats or not stats.get("bytes_limit"):
         return None
     return int(stats["bytes_limit"] * DATASET_HBM_FRACTION)
+
+
+def _watch(jit_fn, sentry, name: str, *, steps_from_args=None):
+    """Route a jitted step through the compile sentry's explicit AOT
+    lower/compile path (``obs/compile.py``) so every compilation — and any
+    post-warmup recompilation — is timed, fingerprinted, and cost-analyzed.
+    The bare jit dispatch is returned unchanged when observability is off.
+    """
+    if sentry is None:
+        return jit_fn
+    return sentry.watch(jit_fn, name, steps_from_args=steps_from_args)
+
+
+def _epoch_steps_from_args(n_arrays: int):
+    """Steps-per-call extractor for epoch programs: the scan length is
+    ``idx_epoch.shape[0]`` (args are ``(state, *arrays, idx_epoch,
+    base_key, step0)``), letting the sentry normalize the whole-epoch XLA
+    cost back to per-step numbers comparable with the roofline model."""
+
+    def steps(args):
+        return int(args[1 + n_arrays].shape[0])
+
+    return steps
 
 
 def check_epoch_compile_preconditions(
@@ -308,6 +337,7 @@ def make_pretrain_step(
     remat: bool = False,
     out_size: int = 32,
     grad_allreduce: str = "exact",
+    sentry=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
     """Build the jitted contrastive train step.
 
@@ -335,7 +365,9 @@ def make_pretrain_step(
         out_specs=_REP,
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    return _watch(
+        jax.jit(sharded, donate_argnums=(0,)), sentry, "pretrain_step"
+    )
 
 
 def make_pretrain_epoch_fn(
@@ -352,6 +384,7 @@ def make_pretrain_epoch_fn(
     out_size: int = 32,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
+    sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled training: one XLA program per EPOCH, zero host work
     per step.
@@ -390,7 +423,12 @@ def make_pretrain_epoch_fn(
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
         grad_allreduce=grad_allreduce,
     )
-    return _make_epoch_fn(per_step, mesh, n_arrays=1, residency=residency)
+    return _watch(
+        _make_epoch_fn(per_step, mesh, n_arrays=1, residency=residency),
+        sentry,
+        "pretrain_epoch",
+        steps_from_args=_epoch_steps_from_args(1),
+    )
 
 
 def _sharded_rows_global_batch(local_rows, idx_step):
@@ -534,6 +572,7 @@ def make_supervised_step(
     strength: float = 0.5,
     out_size: int = 32,
     grad_allreduce: str = "exact",
+    sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Jitted supervised CE train step (one SimCLR-augmented view).
 
@@ -552,7 +591,9 @@ def make_supervised_step(
         out_specs=_REP,
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    return _watch(
+        jax.jit(sharded, donate_argnums=(0,)), sentry, "supervised_step"
+    )
 
 
 def make_supervised_epoch_fn(
@@ -564,6 +605,7 @@ def make_supervised_epoch_fn(
     out_size: int = 32,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
+    sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled supervised training (see
     :func:`make_pretrain_epoch_fn` — same design: dataset resident on
@@ -577,7 +619,12 @@ def make_supervised_epoch_fn(
         model, tx, strength=strength, out_size=out_size,
         grad_allreduce=grad_allreduce,
     )
-    return _make_epoch_fn(per_step, mesh, n_arrays=2, residency=residency)
+    return _watch(
+        _make_epoch_fn(per_step, mesh, n_arrays=2, residency=residency),
+        sentry,
+        "supervised_epoch",
+        steps_from_args=_epoch_steps_from_args(2),
+    )
 
 
 def make_supervised_eval_step(model, mesh) -> Callable[..., Metrics]:

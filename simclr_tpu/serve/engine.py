@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from simclr_tpu.data.augment import to_float
+from simclr_tpu.obs.compile import CompileSentry
 from simclr_tpu.utils.fetch import fetch
 from simclr_tpu.utils.profiling import synchronize
 
@@ -79,6 +80,7 @@ class EmbedEngine:
         input_shape: tuple[int, ...] = (32, 32, 3),
         metrics=None,
         warmup: bool = True,
+        sentry=None,
     ):
         self.model = model
         self.max_batch = int(max_batch)
@@ -86,6 +88,12 @@ class EmbedEngine:
         self.input_shape = tuple(input_shape)
         self.buckets = make_buckets(self.max_batch)
         self.metrics = metrics
+        # compile sentry (obs/compile.py): every bucket compilation is
+        # recorded; a bucket compiled after warmup completes is the serve
+        # tier's recompile alarm. A bare sentry (records only) is kept when
+        # the caller has no events/telemetry to wire in.
+        self.sentry = sentry if sentry is not None else CompileSentry()
+        self._warmup_done = False
         self._warm: set[int] = set()
         # (name, start, end) perf_counter spans of the LAST embed() call
         # (pad + device_compute), read by the batcher's span_source. embed()
@@ -136,6 +144,10 @@ class EmbedEngine:
             synchronize(out)
             times[b] = time.perf_counter() - t0
             self._warm.add(b)
+            self.sentry.record_compile(
+                f"serve_bucket_{b}", seconds=times[b], warm=self._warmup_done
+            )
+        self._warmup_done = True
         return times
 
     # -- request path ------------------------------------------------------
@@ -171,12 +183,15 @@ class EmbedEngine:
             )
         n = images.shape[0]
         bucket = self.bucket_for(n)
+        cold = bucket not in self._warm
         if self.metrics is not None:
-            if bucket in self._warm:
-                self.metrics.compile_cache_hits_total.inc()
-            else:
+            if cold:
                 self.metrics.compile_cache_misses_total.inc()
-        if bucket not in self._warm:
+                if self._warmup_done:
+                    self.metrics.recompile_alarms_total.inc()
+            else:
+                self.metrics.compile_cache_hits_total.inc()
+        if cold:
             self._warm.add(bucket)
         t_pad = time.perf_counter()
         if n < bucket:
@@ -186,6 +201,14 @@ class EmbedEngine:
         t0 = time.perf_counter()
         out = fetch(self._fwd(self._params, self._batch_stats, images))
         done = time.perf_counter()
+        if cold:
+            # the compiling dispatch: its duration upper-bounds the compile.
+            # warm=True (post-warmup cold bucket) raises the recompile alarm.
+            self.sentry.record_compile(
+                f"serve_bucket_{bucket}",
+                seconds=done - t0,
+                warm=self._warmup_done,
+            )
         # kept even for exact-bucket batches (a ~0 pad span) so every
         # request trace carries the same span shape
         self.last_spans = (("pad", t_pad, t0), ("device_compute", t0, done))
@@ -210,7 +233,7 @@ class EmbedEngine:
 
     # -- construction from a run directory ---------------------------------
     @classmethod
-    def from_checkpoint(cls, cfg, *, metrics=None, warmup: bool = True):
+    def from_checkpoint(cls, cfg, *, metrics=None, warmup: bool = True, sentry=None):
         """Restore the newest (or explicitly chosen) checkpoint of a run.
 
         Uses eval's blessed constructor/loader so served embeddings are the
@@ -239,6 +262,7 @@ class EmbedEngine:
             use_full_encoder=bool(cfg.parameter.use_full_encoder),
             metrics=metrics,
             warmup=warmup,
+            sentry=sentry,
         )
         engine.checkpoint_path = str(ckpt)
         return engine

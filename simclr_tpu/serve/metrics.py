@@ -50,6 +50,9 @@ class ServeMetrics:
         self.compile_cache_misses_total = Counter(
             "simclr_serve_compile_cache_misses_total",
             "Engine batches that compiled a cold bucket")
+        self.recompile_alarms_total = Counter(
+            "simclr_serve_recompile_alarms_total",
+            "Buckets compiled after warmup completed — live traffic paid a compile")
         self.queue_depth = Gauge(
             "simclr_serve_queue_depth", "Requests waiting in the batcher queue")
         self.request_latency_ms = Summary(
@@ -80,7 +83,8 @@ class ServeMetrics:
                 self.failed_total, self.batches_total,
                 self.batch_requests_total, self.batch_rows_total,
                 self.batch_capacity_total, self.compile_cache_hits_total,
-                self.compile_cache_misses_total, self.queue_depth,
+                self.compile_cache_misses_total, self.recompile_alarms_total,
+                self.queue_depth,
                 self.request_latency_ms, self.batch_latency_ms,
                 self.client_disconnects_total,
             )

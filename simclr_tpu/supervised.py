@@ -34,6 +34,8 @@ from simclr_tpu.data.pipeline import EpochIterator, epoch_index_matrix
 from simclr_tpu.data.prefetch import prefetch
 from simclr_tpu.models.contrastive import SupervisedModel
 from simclr_tpu.obs.anomaly import maybe_detector
+from simclr_tpu.obs.compile import maybe_sentry
+from simclr_tpu.obs.device import maybe_dump_oom_profile, maybe_monitor
 from simclr_tpu.obs.events import EventLog
 from simclr_tpu.obs.exporter import maybe_start_exporter
 from simclr_tpu.obs.telemetry import Telemetry
@@ -152,13 +154,55 @@ def run_supervised(cfg: Config) -> dict:
     )
     state = jax.device_put(state, replicated_sharding(mesh))
 
+    save_dir = resolve_save_dir(cfg)
+    # run telemetry + event timeline (simclr_tpu/obs/, docs/OBSERVABILITY.md),
+    # constructed BEFORE the step builders so the compile sentry can watch
+    # them. arch=None: the roofline FLOP model covers the pretrain step only,
+    # so the supervised MFU gauge honestly reads 0.
+    telemetry = Telemetry(
+        arch=None,
+        per_device_batch=int(cfg.experiment.batches),
+        global_batch=global_batch,
+        n_devices=jax.device_count(),
+        grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+        grad_elements=param_count(state.params),
+        allreduce_devices=mesh.shape[DATA_AXIS],
+    )
+    events = EventLog(
+        save_dir,
+        enabled=bool(cfg.select("telemetry.events", True)) and is_logging_host(),
+    )
+    # fault-tolerance guard: preemption checkpointing, heartbeat, non-finite
+    # loss rollback (simclr_tpu/supervisor/, docs/FAULT_TOLERANCE.md)
+    guard = RunGuard(
+        save_dir,
+        nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
+        telemetry=telemetry,
+        events=events,
+    )
+    # step anomaly detection (obs/anomaly.py): slow-step classifier + stall
+    # watchdog + rate-limited auto-trace, host clock reads only
+    detector = (
+        maybe_detector(cfg, save_dir, telemetry=telemetry, events=events)
+        if is_logging_host() else None
+    )
+    # compile sentry (obs/compile.py): times/fingerprints/cost-analyzes
+    # every step compilation, alarms on post-warmup recompiles
+    sentry = (
+        maybe_sentry(cfg, telemetry=telemetry, events=events, detector=detector)
+        if is_logging_host() else None
+    )
+
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
     eval_step = make_supervised_eval_step(model, mesh)
     data_shard = batch_sharding(mesh)
+    # analytic per-chip resident dataset bytes from the epoch-compile
+    # preflight; the DeviceMonitor reconciles it against measured live HBM
+    resident_bytes = None
     if epoch_compile:
         # see main.py: sharded residency keeps N/n_data rows per data shard
         residency = str(cfg.select("runtime.dataset_residency", "replicated"))
-        check_epoch_compile_preconditions(
+        resident_bytes = check_epoch_compile_preconditions(
             len(train_ds), global_batch, cfg.select("experiment.profile_dir"),
             dataset_bytes=train_ds.images.nbytes + train_ds.labels.nbytes,
             n_data_shards=mesh.shape[DATA_AXIS],
@@ -168,6 +212,7 @@ def run_supervised(cfg: Config) -> dict:
             model, tx, mesh, strength=float(cfg.experiment.strength),
             residency=residency,
             grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+            sentry=sentry,
         )
         put_dataset = put_replicated if residency == "replicated" else put_row_sharded
         images_all = put_dataset(train_ds.images, mesh)
@@ -177,11 +222,20 @@ def run_supervised(cfg: Config) -> dict:
         train_step = make_supervised_step(
             model, tx, mesh, strength=float(cfg.experiment.strength),
             grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+            sentry=sentry,
         )
         train_iter = EpochIterator(
             train_ds, global_batch, seed=seed, shuffle=True, sharding=data_shard,
             gather_threads=int(cfg.parameter.num_workers),
         )
+    # live HBM accounting (obs/device.py): sampled per scrape from the
+    # exporter thread — host-side allocator queries, zero device syncs
+    monitor = (
+        maybe_monitor(cfg, events=events, expected_resident_bytes=resident_bytes)
+        if is_logging_host() else None
+    )
+    if monitor is not None:
+        telemetry.attach_device_monitor(monitor)
     # validation: no shuffle, keep every sample (reference drop_last=False,
     # supervised.py:219-223). The tail remainder is zero-padded to the static
     # batch shape and masked out inside the one jitted eval step — a single
@@ -218,7 +272,6 @@ def run_supervised(cfg: Config) -> dict:
             count += float(totals["count"])
         return sum_loss / max(count, 1.0), correct / max(count, 1.0)
 
-    save_dir = resolve_save_dir(cfg)
     metric = str(cfg.parameter.metric)
     if is_logging_host():
         os.makedirs(save_dir, exist_ok=True)
@@ -234,36 +287,6 @@ def run_supervised(cfg: Config) -> dict:
     best_epoch = 0
     start_epoch = 1
     skip_steps = 0
-    # run telemetry + event timeline (simclr_tpu/obs/, docs/OBSERVABILITY.md).
-    # arch=None: the roofline FLOP model covers the pretrain step only, so
-    # the supervised MFU gauge honestly reads 0.
-    telemetry = Telemetry(
-        arch=None,
-        per_device_batch=int(cfg.experiment.batches),
-        global_batch=global_batch,
-        n_devices=jax.device_count(),
-        grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
-        grad_elements=param_count(state.params),
-        allreduce_devices=mesh.shape[DATA_AXIS],
-    )
-    events = EventLog(
-        save_dir,
-        enabled=bool(cfg.select("telemetry.events", True)) and is_logging_host(),
-    )
-    # fault-tolerance guard: preemption checkpointing, heartbeat, non-finite
-    # loss rollback (simclr_tpu/supervisor/, docs/FAULT_TOLERANCE.md)
-    guard = RunGuard(
-        save_dir,
-        nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
-        telemetry=telemetry,
-        events=events,
-    )
-    # step anomaly detection (obs/anomaly.py): slow-step classifier + stall
-    # watchdog + rate-limited auto-trace, host clock reads only
-    detector = (
-        maybe_detector(cfg, save_dir, telemetry=telemetry, events=events)
-        if is_logging_host() else None
-    )
     events.emit(
         "run_start", entry="supervised", epochs=epochs,
         steps_per_epoch=steps_per_epoch, global_batch=global_batch,
@@ -494,6 +517,12 @@ def run_supervised(cfg: Config) -> dict:
                     delete_checkpoint(prev_best)
             timer.resume()
             epoch += 1
+    except Exception as exc:
+        # allocator RESOURCE_EXHAUSTED: capture the device memory profile +
+        # an ``oom`` event before the error propagates (no-op otherwise)
+        if is_logging_host():
+            maybe_dump_oom_profile(save_dir, exc, events=events)
+        raise
     finally:
         guard.restore_signals()
         if detector is not None:

@@ -91,6 +91,9 @@ simclr_serve_compile_cache_hits_total 3
 # HELP simclr_serve_compile_cache_misses_total Engine batches that compiled a cold bucket
 # TYPE simclr_serve_compile_cache_misses_total counter
 simclr_serve_compile_cache_misses_total 1
+# HELP simclr_serve_recompile_alarms_total Buckets compiled after warmup completed — live traffic paid a compile
+# TYPE simclr_serve_recompile_alarms_total counter
+simclr_serve_recompile_alarms_total 0
 # HELP simclr_serve_queue_depth Requests waiting in the batcher queue
 # TYPE simclr_serve_queue_depth gauge
 simclr_serve_queue_depth 2
@@ -265,7 +268,7 @@ class TestTelemetry:
         assert set(snap) == {
             "epoch", "step", "loss", "lr", "imgs_per_sec",
             "imgs_per_sec_per_chip", "mfu", "slow_steps", "stalls",
-            "auto_traces", "uptime_s",
+            "auto_traces", "compiles", "recompile_alarms", "uptime_s",
         }
         assert snap["loss"] == 2.5
         assert json.loads(json.dumps(snap)) == snap  # heartbeat-serializable
@@ -1048,6 +1051,10 @@ class TestEndToEnd:
                     _, _, body = _get(f"http://127.0.0.1:{port}/metrics")
                     _get(f"http://127.0.0.1:{port}/healthz")
                     assert "simclr_train_imgs_per_sec" in body
+                    # the DeviceMonitor samples on this scrape path; its
+                    # fallback gauge must be present on every backend and
+                    # (per the sync-count assertion below) add zero fences
+                    assert "simclr_train_hbm_high_watermark_bytes" in body
                     scrapes[0] += 1
                 except (urllib.error.URLError, OSError):
                     pass  # exporter already closed at run end

@@ -21,7 +21,7 @@ WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "remat2048", "explore1024", "explore512",
-    "supervisor_smoke", "obs_smoke", "run_report",
+    "supervisor_smoke", "obs_smoke", "compile_audit", "run_report",
 )
 
 
@@ -75,10 +75,14 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         'case "$*" in *simclr_tpu.supervisor*) '
         'echo \'{"outcome": "clean", "exit": 0, "attempts": 2, '
         '"resumed": 1, "restarts": {"crashed": 1}}\';; esac',
-        # the obs_smoke stage greps its stdout for a live imgs/s gauge line
-        # from the printed /metrics catalog (rc 0 alone proves nothing)
+        # obs_smoke.py backs two stages: obs_smoke greps its stdout for a
+        # live imgs/s gauge line, compile_audit for a positive compile
+        # counter plus a zero recompile-alarm counter — the stub echoes all
+        # three so each stage's marker grep exercises only its own contract
         'case "$*" in *obs_smoke.py*) '
-        "echo 'simclr_train_imgs_per_sec 12345.6';; esac",
+        "echo 'simclr_train_imgs_per_sec 12345.6'; "
+        "echo 'simclr_train_compiles_total 3'; "
+        "echo 'simclr_train_recompile_alarms_total 0';; esac",
         # the run_report stage greps for a COMPUTED verdict (OK|REGRESSION):
         # a NO_DATA/NO_BASELINE report exits 0 but proves nothing
         'case "$*" in *simclr_tpu.obs.report*) '
@@ -210,6 +214,23 @@ def test_obs_marker_requires_live_throughput_gauge(tmp_path):
     assert "obs_smoke" not in _done(state)
     assert (state / "obs_smoke.fails").exists()
     assert "stage obs_smoke FAILED" in log.read_text()
+
+
+def test_compile_audit_marker_requires_quiet_sentry(tmp_path):
+    """compile_audit exiting 0 with a non-zero recompile-alarm counter in
+    its /metrics catalog (the sentry fired mid-smoke) must not earn
+    compile_audit.done — and must leave the obs_smoke stage, which shares
+    the same script, untouched."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        "simclr_train_recompile_alarms_total 0",
+        "simclr_train_recompile_alarms_total 2"))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "compile_audit" not in _done(state)
+    assert "obs_smoke" in _done(state)
+    assert (state / "compile_audit.fails").exists()
+    assert "stage compile_audit FAILED" in log.read_text()
 
 
 def test_run_report_marker_requires_computed_verdict(tmp_path):

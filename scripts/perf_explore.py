@@ -1,9 +1,11 @@
 """Perf exploration on real TPU: time pretrain-step variants at batch 512.
 
 Compares forward_mode (two_pass vs concat), fused Pallas NT-Xent, remat,
-and epoch-compiled scan against the bench.py default, all with value-fetch
-synchronization (see bench.py's measurement-integrity note). Prints one JSON
-line per variant. Not part of the driver bench contract — a tuning tool.
+epoch-compiled scan, and the superepoch K-sweep (one program per K epochs;
+reports compile time and host syncs per epoch) against the bench.py default,
+all with value-fetch synchronization (see bench.py's measurement-integrity
+note). Prints one JSON line per variant. Not part of the driver bench
+contract — a tuning tool.
 
 Usage: python scripts/perf_explore.py [--steps 100] [--batch 512]
        [--variants two_pass,concat,...]
@@ -33,7 +35,11 @@ from simclr_tpu.parallel.mesh import (
     put_row_sharded,
     replicated_sharding,
 )
-from simclr_tpu.parallel.steps import make_pretrain_epoch_fn, make_pretrain_step
+from simclr_tpu.parallel.steps import (
+    make_pretrain_epoch_fn,
+    make_pretrain_step,
+    make_pretrain_superepoch_fn,
+)
 from simclr_tpu.parallel.train_state import create_train_state
 from simclr_tpu.utils.profiling import time_step_loop
 from simclr_tpu.utils.schedule import calculate_initial_lr, warmup_cosine_schedule
@@ -50,7 +56,14 @@ VARIANTS = {
     # batch assembly — quantifies the collective's cost against the
     # replicated scan (expected <0.1% of step time, docs/PERF.md)
     "epoch_compile_sharded": dict(forward_mode="two_pass"),
+    # superepochs (runtime.epochs_per_compile): ONE program per K epochs;
+    # sweeps K in SUPEREPOCH_KS and reports compile time and host syncs per
+    # epoch (= 1/K) alongside throughput — the Podracer trade, docs/PERF.md
+    # "Host round-trip budget"
+    "superepoch": dict(forward_mode="two_pass"),
 }
+
+SUPEREPOCH_KS = (1, 2, 5, 10)
 
 
 def build_state(model, tx, mesh):
@@ -95,6 +108,50 @@ def main() -> None:
     for name in args.variants.split(","):
         kw = VARIANTS[name]
         state = build_state(model, tx, mesh)
+        if name == "superepoch":
+            images_all = jax.device_put(ds.images, replicated_sharding(mesh))
+            n = ds.images.shape[0]
+            for k in SUPEREPOCH_KS:
+                superepoch_fn = make_pretrain_superepoch_fn(
+                    model, tx, mesh, temperature=0.5, strength=0.5,
+                    negatives="global", **kw,
+                )
+                # equal timed work per K: K epochs of steps//K steps each
+                spe = max(args.steps // k, 1)
+                idx = np.random.default_rng(0).integers(
+                    0, n, size=(k, spe, global_batch), dtype=np.int32
+                )
+                idx_d = jax.device_put(
+                    jnp.asarray(idx), replicated_sharding(mesh)
+                )
+                state = build_state(model, tx, mesh)
+                t0 = time.perf_counter()
+                state, hist = superepoch_fn(
+                    state, images_all, idx_d, rng, jnp.int32(0)
+                )
+                loss = float(hist["loss"][-1, -1])
+                t_warm = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                state, hist = superepoch_fn(
+                    state, images_all, idx_d, rng, jnp.int32(0)
+                )
+                loss = float(hist["loss"][-1, -1])
+                dt = time.perf_counter() - t0
+                total = k * spe
+                print(json.dumps({
+                    "variant": f"superepoch_k{k}",
+                    "epochs_per_compile": k,
+                    "steps_per_epoch": spe,
+                    "imgs_per_sec_per_chip": round(
+                        total * global_batch / dt / mesh.size, 1
+                    ),
+                    "ms_per_step": round(dt / total * 1e3, 2),
+                    "compile_s": round(max(t_warm - dt, 0.0), 2),
+                    # the whole point: boundary fetches per trained epoch
+                    "host_syncs_per_epoch": round(1.0 / k, 3),
+                    "final_loss": round(loss, 4),
+                }), flush=True)
+            continue
         if name.startswith("epoch_compile"):
             residency = "sharded" if name.endswith("_sharded") else "replicated"
             epoch_fn = make_pretrain_epoch_fn(

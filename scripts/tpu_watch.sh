@@ -51,7 +51,7 @@ MAX_STAGE_FAILS=3
 # PERF.md's compressed-collectives rows are pending on it), then the
 # remaining step matrices, and last the supervisor kill/resume smoke
 # (fault tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -251,6 +251,25 @@ run_stage() {
             if [ "$rc" -eq 0 ]; then
                 grep -Eq '^simclr_train_compiles_total [1-9][0-9]*$' "$out" \
                     && grep -Eq '^simclr_train_recompile_alarms_total 0$' "$out"
+                rc=$?
+            fi ;;
+        superepoch)
+            # superepoch (runtime.epochs_per_compile) evidence ON the chip
+            # (scripts/superepoch_smoke.py): a K>1 superepoch program must
+            # reproduce K single-epoch programs (parity), the CompileSentry
+            # must have seen the compiles, and a steady-shape repeat call
+            # must raise ZERO recompile alarms — rc 0 alone proves nothing
+            # (the script could crash before the parity check), so the done
+            # marker requires all three evidence lines.
+            out="$STATE/superepoch.out"
+            run_locked "$(stage_timeout 1200)" python scripts/superepoch_smoke.py \
+                --k 4 --steps 4 --batch 256 > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -Eq '^superepoch_parity OK' "$out" \
+                    && grep -Eq '^superepoch_compiles_total [1-9][0-9]*$' "$out" \
+                    && grep -Eq '^superepoch_recompile_alarms_total 0$' "$out"
                 rc=$?
             fi ;;
         run_report)

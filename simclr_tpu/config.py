@@ -441,6 +441,17 @@ def _check_runtime_conf(cfg: Config) -> None:
         in ("replicated", "sharded"),
         "runtime.dataset_residency must be 'replicated' or 'sharded'",
     )
+    k = cfg.select("runtime.epochs_per_compile", 1)
+    _require(
+        isinstance(k, int) and not isinstance(k, bool) and k >= 1,
+        f"runtime.epochs_per_compile must be an int >= 1, got {k!r}",
+    )
+    _require(
+        k == 1 or bool(cfg.select("runtime.epoch_compile", False)),
+        "runtime.epochs_per_compile > 1 (superepochs) requires "
+        "runtime.epoch_compile=true — the superepoch scan is the epoch "
+        "scan's outer loop",
+    )
     _check_parallel_conf(cfg)
     _check_supervisor_conf(cfg)
     _check_telemetry_conf(cfg)

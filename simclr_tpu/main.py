@@ -59,6 +59,8 @@ from simclr_tpu.parallel.steps import (
     check_epoch_compile_preconditions,
     make_pretrain_epoch_fn,
     make_pretrain_step,
+    make_pretrain_superepoch_fn,
+    superepoch_steps_from_args,
 )
 from simclr_tpu.parallel.train_state import create_train_state, param_count
 from simclr_tpu.supervisor.guard import (
@@ -265,6 +267,32 @@ def run_pretrain(cfg: Config) -> dict:
             "and cannot resume under runtime.epoch_compile=true; resume with "
             "runtime.epoch_compile=false"
         )
+    # runtime.epochs_per_compile=K > 1: superepochs — one XLA program per K
+    # epochs (the Podracer pattern); full-K chunks cover epochs
+    # 1..K*(epochs//K), the tail (< K epochs) runs on the single-epoch path
+    # so every compiled program keeps one stable signature
+    epochs_per_compile = int(cfg.select("runtime.epochs_per_compile", 1) or 1)
+    superepoch = epoch_compile and epochs_per_compile > 1
+    full_super_end = (epochs // epochs_per_compile) * epochs_per_compile
+
+    def _check_superepoch_resume(at_epoch: int) -> None:
+        """Superepoch chunks are indivisible like epochs are: a checkpoint
+        inside a full-K chunk (not on a K boundary, not in the tail) cannot
+        seed a resume — rejected the way mid-epoch checkpoints are above."""
+        if (
+            superepoch
+            and at_epoch <= full_super_end
+            and (at_epoch - 1) % epochs_per_compile
+        ):
+            raise ValueError(
+                f"checkpoint at epoch {at_epoch - 1} is mid-superepoch "
+                f"(epoch {(at_epoch - 1) % epochs_per_compile} of a "
+                f"{epochs_per_compile}-epoch chunk) and cannot resume under "
+                f"runtime.epochs_per_compile={epochs_per_compile}; resume "
+                "with runtime.epochs_per_compile=1"
+            )
+
+    _check_superepoch_resume(start_epoch)
     # runtime.dataset_residency: "replicated" keeps the whole dataset in every
     # chip's HBM; "sharded" keeps N/n_data rows per data shard and reassembles
     # each step's batch with one O(global_batch) psum inside the epoch scan
@@ -272,6 +300,49 @@ def run_pretrain(cfg: Config) -> dict:
     residency = str(cfg.select("runtime.dataset_residency", "replicated"))
     put_dataset = put_replicated if residency == "replicated" else put_row_sharded
     data_shard = batch_sharding(mesh)
+    # experiment.eval_every > 0: centroid-probe the test split every N
+    # epochs — a REAL monitor where the reference's validation() is an
+    # empty stub (/root/reference/main.py:53-58, SURVEY §2.5.6). Off by
+    # default for recipe parity. Read before the builders: under
+    # superepochs the probe compiles INTO the training program.
+    eval_every = int(cfg.select("experiment.eval_every", 0) or 0)
+    test_ds = None
+    if eval_every > 0:
+        test_ds = load_dataset(
+            cfg.experiment.name, "test",
+            data_dir=cfg.select("experiment.data_dir"),
+            synthetic_ok=bool(cfg.select("experiment.synthetic_data", False)),
+            synthetic_size=cfg.select("experiment.synthetic_size"),
+            synthetic_noise=cfg.select("experiment.synthetic_noise"),
+        )
+
+    def _pad_rows(a: np.ndarray, mult: int) -> np.ndarray:
+        """Zero-pad rows to a multiple of ``mult``. Padding appends AFTER the
+        real rows, so global row indices are unchanged: training gathers
+        (index < N) never see it and the monitor masks it by row position."""
+        pad = -len(a) % mult
+        if pad == 0:
+            return a
+        return np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)]
+        )
+
+    # superepoch in-program monitor: the centroid probe runs INSIDE the
+    # compiled K-epoch program against an HBM-resident test split, so
+    # monitoring costs zero extra host syncs (eval.py's host path stays the
+    # parity reference and serves the tail/epoch-0 probes)
+    probe_local = None
+    probe_arrays: tuple = ()
+    if superepoch and eval_every > 0:
+        from simclr_tpu.eval import build_eval_model, make_local_centroid_monitor
+
+        probe_local = make_local_centroid_monitor(
+            build_eval_model(cfg),
+            num_classes=dataset.num_classes,
+            n_train=len(dataset),
+            n_test=len(test_ds),
+            top_k=5,
+        )
     # analytic per-chip resident dataset bytes from the epoch-compile
     # preflight; the DeviceMonitor reconciles it against measured live HBM
     resident_bytes = None
@@ -301,6 +372,12 @@ def run_pretrain(cfg: Config) -> dict:
                 dataset_bytes=dataset.images.nbytes,
                 n_data_shards=n_data,
                 residency=residency,
+                epochs_per_compile=epochs_per_compile,
+                steps_per_epoch=steps_per_epoch,
+                probe_bytes=(
+                    test_ds.images.nbytes if probe_local is not None else None
+                ),
+                probe_samples=len(test_ds) if probe_local is not None else 0,
             )
             epoch_fn = make_pretrain_epoch_fn_tp(
                 model, tx, mesh,
@@ -317,7 +394,34 @@ def run_pretrain(cfg: Config) -> dict:
                     epoch_fn, "pretrain_epoch",
                     steps_from_args=lambda args: int(args[2].shape[0]),
                 )
-            images_all = put_dataset(dataset.images, mesh)
+            superepoch_fn = None
+            if superepoch:
+                from simclr_tpu.parallel.tp import make_pretrain_superepoch_fn_tp
+
+                superepoch_fn = make_pretrain_superepoch_fn_tp(
+                    model, tx, mesh,
+                    temperature=step_kwargs["temperature"],
+                    strength=step_kwargs["strength"],
+                    remat=step_kwargs["remat"],
+                    residency=residency,
+                    grad_allreduce=step_kwargs["grad_allreduce"],
+                    monitor=probe_local,
+                )
+                if sentry is not None:
+                    # its own watched name: the K-epoch program legitimately
+                    # has a different signature from the single-epoch one
+                    superepoch_fn = sentry.watch(
+                        superepoch_fn, "pretrain_superepoch",
+                        steps_from_args=superepoch_steps_from_args(
+                            2 + (3 if probe_local is not None else 0)
+                        ),
+                    )
+            train_rows = (
+                _pad_rows(dataset.images, n_data)
+                if probe_local is not None and residency == "replicated"
+                else dataset.images
+            )
+            images_all = put_dataset(train_rows, mesh)
             iterator = None
         else:
             step_fn = make_pretrain_step_tp(
@@ -339,21 +443,57 @@ def run_pretrain(cfg: Config) -> dict:
             dataset_bytes=dataset.images.nbytes,
             n_data_shards=n_data,
             residency=residency,
+            epochs_per_compile=epochs_per_compile,
+            steps_per_epoch=steps_per_epoch,
+            probe_bytes=(
+                test_ds.images.nbytes if probe_local is not None else None
+            ),
+            probe_samples=len(test_ds) if probe_local is not None else 0,
         )
         epoch_fn = make_pretrain_epoch_fn(
             model, tx, mesh, residency=residency, **step_kwargs
         )
+        superepoch_fn = None
+        if superepoch:
+            superepoch_fn = make_pretrain_superepoch_fn(
+                model, tx, mesh, residency=residency, monitor=probe_local,
+                **step_kwargs,
+            )
         # the uint8 dataset lives in HBM for the run (full per chip, or
         # N/n_data rows per shard under sharded residency); batches are
         # gathered on device by shuffled index inside the epoch scan.
-        # both uploads are multi-host safe
-        images_all = put_dataset(dataset.images, mesh)
+        # both uploads are multi-host safe. With the in-program monitor under
+        # replicated residency the rows are zero-padded to a multiple of the
+        # data shards so each shard's probe block slices evenly; padding sits
+        # after the real rows and training indices (< N) never touch it
+        train_rows = (
+            _pad_rows(dataset.images, n_data)
+            if probe_local is not None and residency == "replicated"
+            else dataset.images
+        )
+        images_all = put_dataset(train_rows, mesh)
         iterator = None
     else:
         step_fn = make_pretrain_step(model, tx, mesh, **step_kwargs)
         iterator = EpochIterator(
             dataset, global_batch, seed=seed, shuffle=True, sharding=data_shard,
             gather_threads=int(cfg.parameter.num_workers),
+        )
+
+    if probe_local is not None:
+        # HBM-resident probe inputs for the in-program monitor: labels are
+        # replicated (tiny), the test split follows the training residency.
+        # Rows are padded to a multiple of the data shards so each shard owns
+        # one contiguous block; the validity masks built into probe_local use
+        # the REAL row counts, so padding never scores
+        probe_arrays = (
+            put_replicated(_pad_rows(dataset.labels, n_data), mesh),
+            put_dataset(
+                _pad_rows(test_ds.images, n_data)
+                if residency == "replicated" else test_ds.images,
+                mesh,
+            ),
+            put_replicated(_pad_rows(test_ds.labels, n_data), mesh),
         )
 
     # live HBM accounting (obs/device.py): per-device memory_stats gauges
@@ -380,11 +520,6 @@ def run_pretrain(cfg: Config) -> dict:
     base_key = jax.random.key(seed + 1)
     metrics = {"loss": jnp.zeros(())}
     save_model_epoch = int(cfg.experiment.save_model_epoch)
-    # experiment.eval_every > 0: centroid-probe the test split every N
-    # epochs — a REAL monitor where the reference's validation() is an
-    # empty stub (/root/reference/main.py:53-58, SURVEY §2.5.6). Off by
-    # default for recipe parity.
-    eval_every = int(cfg.select("experiment.eval_every", 0) or 0)
     monitor_val_acc = None
     # per-epoch evidence curves (loss always; monitor when eval_every>0) as
     # [epoch, value] pairs — self-describing under resume, where the run
@@ -428,13 +563,9 @@ def run_pretrain(cfg: Config) -> dict:
         )
 
     if eval_every > 0:
-        test_ds = load_dataset(
-            cfg.experiment.name, "test",
-            data_dir=cfg.select("experiment.data_dir"),
-            synthetic_ok=bool(cfg.select("experiment.synthetic_data", False)),
-            synthetic_size=cfg.select("experiment.synthetic_size"),
-            synthetic_noise=cfg.select("experiment.synthetic_noise"),
-        )
+        # host-side probe: used every eval_every epochs on the per-step and
+        # single-epoch paths, and for the epoch-0/tail probes under
+        # superepochs (in-chunk probes run inside the compiled program)
         # on-device reshard to replicated: the encode program expects
         # replicated variables, and a TP run's live head leaves are
         # model-sharded global arrays that span non-addressable devices
@@ -492,11 +623,15 @@ def run_pretrain(cfg: Config) -> dict:
     t_start = time.time()
     # steady-state throughput, excluding the first (compiling) steps; the
     # per-epoch log line reports the cumulative rate instead. In
-    # epoch_compile mode one tick covers a whole epoch of steps.
-    timer = StepTimer(
-        global_batch * (steps_per_epoch if epoch_compile else 1),
-        warmup=1 if epoch_compile else 3,
-    )
+    # epoch_compile mode one tick covers a whole epoch of steps; under
+    # superepochs one tick covers K epochs (tail epochs, a different
+    # program, skip the timer — mixed tick sizes would skew the rate)
+    imgs_per_tick = global_batch
+    if epoch_compile:
+        imgs_per_tick = global_batch * steps_per_epoch
+        if superepoch:
+            imgs_per_tick *= epochs_per_compile
+    timer = StepTimer(imgs_per_tick, warmup=1 if epoch_compile else 3)
     stem = str(cfg.experiment.output_model_name)
     # process-0 /metrics + /debug/trace exporter; None unless telemetry.port
     # (or telemetry.ready_file for an ephemeral port) is configured
@@ -510,6 +645,168 @@ def run_pretrain(cfg: Config) -> dict:
         while epoch <= epochs:
             epoch_start_step = cur_step
             epoch_t0 = time.perf_counter()
+            # full-K superepoch chunk: one compiled call runs K epochs (and
+            # their probes) on device; the host only syncs here, at the
+            # boundary, to fetch the stacked per-epoch metrics. The tail
+            # (epochs past the last full chunk) falls through to the
+            # single-epoch program below.
+            if (
+                superepoch
+                and (epoch - 1) % epochs_per_compile == 0
+                and epoch + epochs_per_compile - 1 <= epochs
+            ):
+                K = epochs_per_compile
+                chunk = list(range(epoch, epoch + K))
+                boundary = chunk[-1]
+                idx_super = jnp.asarray(
+                    np.stack([
+                        epoch_index_matrix(
+                            len(dataset), seed, e, steps_per_epoch, global_batch
+                        )
+                        for e in chunk
+                    ])
+                )
+                if probe_local is not None:
+                    probed = [e % eval_every == 0 or e == epochs for e in chunk]
+                    state, hist = superepoch_fn(
+                        state, images_all, *probe_arrays,
+                        idx_super, jnp.asarray(probed), base_key, cur_step,
+                    )
+                else:
+                    probed = [False] * K
+                    state, hist = superepoch_fn(
+                        state, images_all, idx_super, base_key, cur_step
+                    )
+                metrics = {"loss": hist["loss"][-1, -1]}
+                timer.tick(hist["loss"])
+                # the boundary fetch: K epochs of losses (and probe rows)
+                # come back in one transfer of K*steps_per_epoch floats
+                hist = jax.device_get(hist)
+                losses = np.asarray(hist["loss"])
+                cur_step += K * steps_per_epoch
+                if detector is not None:
+                    detector.tick(cur_step, boundary)
+                    detector.pause()
+                if guard.preempt_requested:
+                    # same boundary-checkpoint contract as below; cur_step is
+                    # a multiple of steps_per_epoch so this lands as the
+                    # regular boundary checkpoint name
+                    timer.pause(metrics["loss"])
+                    path = os.path.join(
+                        save_dir,
+                        preempt_checkpoint_name(cur_step, steps_per_epoch, stem),
+                    )
+                    t_save = time.perf_counter()
+                    save_checkpoint(path, state)
+                    telemetry.observe_save(time.perf_counter() - t_save)
+                    events.emit(
+                        "preempt", step=cur_step, epoch=boundary, checkpoint=path
+                    )
+                    guard.beat_preempted(cur_step, boundary)
+                    raise PreemptedRun(path)
+                chunk_losses = [float(losses[j, -1]) for j in range(K)]
+                # checked_loss is the fault-injection seam on the single-epoch
+                # path; route the boundary loss through it so injected NaNs
+                # still poison superepoch runs
+                chunk_losses[-1] = guard.checked_loss(cur_step, chunk_losses[-1])
+                epoch_loss = chunk_losses[-1]
+                dt = time.perf_counter() - epoch_t0
+                if is_logging_host():
+                    for j, e in enumerate(chunk):
+                        step_e = epoch_start_step + (j + 1) * steps_per_epoch
+                        telemetry.observe_epoch(
+                            e,
+                            epochs=epochs,
+                            step=step_e,
+                            steps=steps_per_epoch,
+                            seconds=dt / K,
+                            loss=chunk_losses[j],
+                            lr=float(schedule(max(step_e - 1, 0))),
+                        )
+                guard.beat(cur_step, boundary, loss=epoch_loss)
+                if any(not math.isfinite(l) for l in chunk_losses):
+                    # same rollback as the single-epoch path; under
+                    # superepochs every checkpoint lands on a K boundary, so
+                    # the resume point realigns (validated below — a stale
+                    # mid-chunk checkpoint from a K=1 run cannot seed this)
+                    first_bad = next(
+                        l for l in chunk_losses if not math.isfinite(l)
+                    )
+                    try:
+                        t_restore = time.perf_counter()
+                        restored, rpath = restore_checkpoint_with_fallback(
+                            save_dir, state
+                        )
+                    except CheckpointCorruptionError as e:
+                        raise PoisonedRun(str(e)) from e
+                    guard.record_rollback(first_bad, rpath)
+                    telemetry.observe_restore(time.perf_counter() - t_restore)
+                    state = restored
+                    cur_step = int(state.step)
+                    epoch, skip_steps = resume_point(cur_step, steps_per_epoch)
+                    _check_superepoch_resume(epoch)
+                    loss_history = [r for r in loss_history if r[0] < epoch]
+                    monitor_history = [r for r in monitor_history if r[0] < epoch]
+                    events.reseat(epoch)
+                    base_key = jax.random.fold_in(
+                        jax.random.key(seed + 1), guard.nan_rollbacks
+                    )
+                    continue
+                if is_logging_host():
+                    lr_now = float(schedule(max(cur_step - 1, 0)))
+                    imgs_per_sec = (
+                        (cur_step - (start_epoch - 1) * steps_per_epoch)
+                        * global_batch / max(time.time() - t_start, 1e-9)
+                    )
+                    logger.info(
+                        "Epoch:%d/%d progress:%.3f loss:%.3f, lr:%.7f, "
+                        "imgs/sec:%.0f (superepoch of %d)",
+                        boundary, epochs, boundary / epochs, epoch_loss,
+                        lr_now, imgs_per_sec, K,
+                    )
+                # per-epoch rows reconstructed from the stacked metrics:
+                # results/events keep the exact shape K=1 produces
+                for j, e in enumerate(chunk):
+                    step_e = epoch_start_step + (j + 1) * steps_per_epoch
+                    loss_history.append([e, chunk_losses[j]])
+                    events.emit(
+                        "epoch", epoch=e, step=step_e, loss=chunk_losses[j],
+                        seconds=round(dt / K, 6),
+                    )
+                    if probed[j]:
+                        monitor_val_acc = float(hist["monitor/val_acc"][j])
+                        telemetry.observe_val_acc(monitor_val_acc)
+                        if is_logging_host():
+                            logger.info(
+                                "Epoch:%d centroid probe: val top-1 %.4f "
+                                "(top-5 %.4f)",
+                                e, monitor_val_acc,
+                                float(hist["monitor/val_top_5_acc"][j]),
+                            )
+                        monitor_history.append([e, monitor_val_acc])
+                if (
+                    any(e % save_model_epoch == 0 for e in chunk)
+                    or boundary == epochs
+                ):
+                    path = os.path.join(save_dir, checkpoint_name(boundary, stem))
+                    timer.pause(metrics["loss"])
+                    t_save = time.perf_counter()
+                    save_checkpoint(path, state)
+                    telemetry.observe_save(time.perf_counter() - t_save)
+                    events.emit("checkpoint", epoch=boundary, path=path)
+                    guard.after_save(boundary, path)
+                    timer.resume()
+                write_results(
+                    {
+                        "epochs": epochs,
+                        "save_dir": save_dir,
+                        "loss_history": loss_history,
+                        "monitor_history": monitor_history,
+                        "complete": False,
+                    }
+                )
+                epoch += K
+                continue
             if epoch_compile:
                 idx_e = jnp.asarray(
                     epoch_index_matrix(
@@ -518,7 +815,10 @@ def run_pretrain(cfg: Config) -> dict:
                 )
                 state, hist = epoch_fn(state, images_all, idx_e, base_key, cur_step)
                 metrics = {"loss": hist["loss"][-1]}
-                timer.tick(hist["loss"])
+                if not superepoch:
+                    # under superepochs this path only runs tail epochs; the
+                    # timer's tick unit is K epochs, so tail epochs stay out
+                    timer.tick(hist["loss"])
                 cur_step += steps_per_epoch
                 if detector is not None:
                     # one tick per epoch here: the detector's "step" unit is
@@ -667,8 +967,12 @@ def run_pretrain(cfg: Config) -> dict:
     tracer.close(pending=metrics["loss"])
     throughput = timer.summary()
     if is_logging_host() and throughput["steps"] > 0:
-        # in epoch_compile mode the timer ticks once per EPOCH; report steps
-        timed_steps = throughput["steps"] * (steps_per_epoch if epoch_compile else 1)
+        # in epoch_compile mode the timer ticks once per EPOCH (once per K
+        # epochs under superepochs); report steps
+        timed_steps = throughput["steps"] * (
+            steps_per_epoch * (epochs_per_compile if superepoch else 1)
+            if epoch_compile else 1
+        )
         logger.info(
             "steady-state: %.0f imgs/sec (%.0f per chip) over %d steps",
             throughput["imgs_per_sec"], throughput["imgs_per_sec_per_chip"],

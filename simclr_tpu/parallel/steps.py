@@ -111,6 +111,19 @@ def _epoch_steps_from_args(n_arrays: int):
     return steps
 
 
+def superepoch_steps_from_args(idx_pos: int):
+    """Steps-per-call extractor for SUPEREPOCH programs: the stacked index
+    tensor at ``args[idx_pos]`` is ``(K, steps_per_epoch, global_batch)``,
+    so one call covers ``K * steps_per_epoch`` optimizer steps — the number
+    the sentry divides the whole-program XLA cost by."""
+
+    def steps(args):
+        idx = args[idx_pos]
+        return int(idx.shape[0] * idx.shape[1])
+
+    return steps
+
+
 def check_epoch_compile_preconditions(
     n_samples: int,
     global_batch: int,
@@ -120,6 +133,10 @@ def check_epoch_compile_preconditions(
     n_data_shards: int = 1,
     residency: str = "replicated",
     hbm_budget_bytes: int | None = None,
+    epochs_per_compile: int = 1,
+    steps_per_epoch: int | None = None,
+    probe_bytes: int | None = None,
+    probe_samples: int = 0,
 ):
     """Shared ``runtime.epoch_compile`` preflight for the entry points.
 
@@ -145,8 +162,21 @@ def check_epoch_compile_preconditions(
     it addresses). Exercised by real 2-process launches in
     tests/test_launch.py.
 
-    Returns the per-chip resident dataset bytes (None when unknown).
+    Superepochs (``runtime.epochs_per_compile=K > 1``) grow the resident
+    footprint in two accounted ways: the index tensor is ``K`` stacked epoch
+    matrices (``K * steps_per_epoch * global_batch`` int32, replicated on
+    every chip), and the in-program ``eval_every`` monitor keeps the test
+    split resident too (``probe_bytes`` over ``probe_samples`` rows, laid
+    out per the same ``residency``). Both are added to the per-chip total
+    before the budget comparison.
+
+    Returns the per-chip resident bytes (dataset + probe split + index
+    tensors; None when the dataset size is unknown).
     """
+    if epochs_per_compile < 1:
+        raise ValueError(
+            f"epochs_per_compile must be >= 1, got {epochs_per_compile}"
+        )
     if n_samples < global_batch:
         # the per-step path raises this inside EpochIterator; here it would
         # otherwise run a zero-length scan and checkpoint untrained params
@@ -167,6 +197,20 @@ def check_epoch_compile_preconditions(
             else -(-n_samples // max(n_data_shards, 1))
         )
         resident_bytes = int(rows_resident * bytes_per_row)
+        if probe_bytes is not None and probe_samples > 0:
+            # the in-program monitor's resident test split follows the same
+            # residency layout as the train set
+            probe_rows = (
+                probe_samples
+                if residency == "replicated"
+                else -(-probe_samples // max(n_data_shards, 1))
+            )
+            resident_bytes += int(probe_rows * (probe_bytes / probe_samples))
+        if steps_per_epoch:
+            # the K-epoch program's stacked index tensor, replicated per chip
+            resident_bytes += int(
+                epochs_per_compile * steps_per_epoch * global_batch * 4
+            )
         budget = (
             device_hbm_budget_bytes()
             if hbm_budget_bytes is None
@@ -517,6 +561,199 @@ def _make_epoch_fn(per_step, mesh, *, n_arrays: int, residency: str = "replicate
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def _local_resident_block(a, residency: str):
+    """This shard's contiguous row block of a device-resident split.
+
+    Inside ``shard_map``: under ``sharded`` residency the local array IS the
+    block (``mesh.put_row_sharded`` layout); under ``replicated`` residency
+    every shard holds the full split and slices its ``[k*R, (k+1)*R)`` rows,
+    which requires the row count to divide by the data-axis size (callers
+    tail-pad before upload — the shapes are static, so a bad pad fails at
+    trace time, not silently)."""
+    if residency == "sharded":
+        return a
+    n_shards = axis_size(DATA_AXIS)
+    if a.shape[0] % n_shards:
+        raise ValueError(
+            f"replicated split of {a.shape[0]} rows does not divide over "
+            f"{n_shards} data shards; tail-pad the upload to a multiple"
+        )
+    rows = a.shape[0] // n_shards
+    return jax.lax.dynamic_slice_in_dim(
+        a, jax.lax.axis_index(DATA_AXIS) * rows, rows
+    )
+
+
+def _make_superepoch_fn(
+    per_step, mesh, *, n_arrays: int, residency: str = "replicated",
+    monitor=None,
+):
+    """Wrap a per-replica step into a SUPEREPOCH ``lax.scan`` — an outer
+    scan over K epochs nested around the per-epoch step scan, all inside
+    ONE ``shard_map``/jit, so one compiled XLA program runs K full epochs
+    (and, optionally, the in-program centroid monitor at epoch boundaries)
+    with zero host syncs in between.
+
+    Contract without ``monitor``::
+
+        (state, *arrays, idx_super, base_key, step0)
+            -> (state, {metric: (K, steps)})
+
+    with ``idx_super`` the ``(K, steps, global_batch)`` int32 stack of K
+    epoch index matrices. Per-step RNG keys fold on the ABSOLUTE step index
+    ``step0 + k*steps + i`` — the same stream as K sequential
+    :func:`_make_epoch_fn` calls, so a K-superepoch is numerically
+    equivalent to K single-epoch calls (test-asserted, usual cross-program
+    tolerances).
+
+    With ``monitor`` (a per-shard probe from
+    ``eval.make_local_centroid_monitor``) the contract widens to::
+
+        (state, *arrays, train_labels, test_rows, test_labels,
+         idx_super, probe_mask, base_key, step0)
+            -> (state, {metric: (K, steps), "monitor/<name>": (K,)})
+
+    where ``probe_mask`` is a (K,) bool — the host-evaluated
+    ``eval_every`` predicate per epoch in the chunk — and probe rows for
+    unprobed epochs are NaN-filled (the ``lax.cond`` skip branch).
+    ``test_rows`` is placed per the same ``residency`` as the train arrays;
+    labels enter replicated, padded to ``n_shards * rows_per_shard``.
+    """
+    if residency not in RESIDENCIES:
+        raise ValueError(
+            f"residency must be one of {RESIDENCIES}, got {residency!r}"
+        )
+
+    def local_super(state: TrainState, *rest):
+        arrays = rest[:n_arrays]
+        if monitor is not None:
+            train_labels, test_rows, test_labels = rest[n_arrays:n_arrays + 3]
+            idx_super, probe_mask, base_key, step0 = rest[n_arrays + 3:]
+        else:
+            idx_super, base_key, step0 = rest[n_arrays:]
+        shard = jax.lax.axis_index(DATA_AXIS)
+        steps = idx_super.shape[1]
+        n_local = idx_super.shape[2] // axis_size(DATA_AXIS)
+
+        def step_body(state, xs):
+            idx_step, i = xs
+            local_idx = jax.lax.dynamic_slice_in_dim(
+                idx_step, shard * n_local, n_local
+            )
+            if residency == "replicated":
+                gathered = [jnp.take(a, local_idx, axis=0) for a in arrays]
+            else:
+                gathered = [
+                    jax.lax.dynamic_slice_in_dim(
+                        _sharded_rows_global_batch(a, idx_step),
+                        shard * n_local,
+                        n_local,
+                    )
+                    for a in arrays
+                ]
+            return per_step(
+                state, *gathered, jax.random.fold_in(base_key, step0 + i)
+            )
+
+        def epoch_body(state, xs):
+            if monitor is not None:
+                idx_epoch, k, pm = xs
+            else:
+                idx_epoch, k = xs
+            offsets = k * steps + jnp.arange(steps, dtype=jnp.int32)
+            state, hist = jax.lax.scan(step_body, state, (idx_epoch, offsets))
+            if monitor is not None:
+                def run(s):
+                    return monitor(
+                        s.params, s.batch_stats,
+                        _local_resident_block(arrays[0], residency),
+                        train_labels,
+                        _local_resident_block(test_rows, residency),
+                        test_labels,
+                    )
+
+                def skip(s):
+                    return {
+                        name: jnp.full((), jnp.nan, jnp.float32)
+                        for name in monitor.metric_names
+                    }
+
+                probe = jax.lax.cond(pm, run, skip, state)
+                hist = dict(hist) | {
+                    f"monitor/{name}": v for name, v in probe.items()
+                }
+            return state, hist
+
+        n_epochs = idx_super.shape[0]
+        epoch_ids = jnp.arange(n_epochs, dtype=jnp.int32)
+        xs = (
+            (idx_super, epoch_ids, probe_mask)
+            if monitor is not None
+            else (idx_super, epoch_ids)
+        )
+        return jax.lax.scan(epoch_body, state, xs)
+
+    array_spec = _REP if residency == "replicated" else _BATCH
+    probe_specs = (_REP, array_spec, _REP) if monitor is not None else ()
+    n_tail = 4 if monitor is not None else 3  # idx, [mask,] key, step0
+    sharded = shard_map(
+        local_super,
+        mesh=mesh,
+        in_specs=(_REP,) + (array_spec,) * n_arrays + probe_specs
+        + (_REP,) * n_tail,
+        out_specs=_REP,
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_pretrain_superepoch_fn(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    negatives: str = "global",
+    fused: bool = False,
+    forward_mode: str = "two_pass",
+    remat: bool = False,
+    out_size: int = 32,
+    residency: str = "replicated",
+    grad_allreduce: str = "exact",
+    monitor=None,
+    sentry=None,
+) -> Callable[..., tuple[TrainState, Metrics]]:
+    """Superepoch-compiled training: ONE XLA program per K EPOCHS
+    (``runtime.epochs_per_compile``), the Podracer/Anakin pattern — the host
+    touches the device only at superepoch boundaries.
+
+    The epoch body is the exact :func:`make_pretrain_epoch_fn` scan wrapped
+    in an outer ``lax.scan`` over the K stacked epoch index matrices;
+    metrics come back STACKED per epoch (``{"loss": (K, steps)}``) so one
+    boundary fetch feeds K epochs of host bookkeeping. With ``monitor``
+    (``eval.make_local_centroid_monitor``) the ``eval_every`` centroid
+    probe runs inside the same program, gated per epoch by ``probe_mask``
+    — monitoring costs zero host syncs. See :func:`_make_superepoch_fn`
+    for the full calling convention and the RNG-equivalence guarantee.
+    """
+    per_step = _make_local_pretrain_step(
+        model, tx,
+        temperature=temperature, strength=strength, negatives=negatives,
+        fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
+        grad_allreduce=grad_allreduce,
+    )
+    idx_pos = 1 + 1 + (3 if monitor is not None else 0)
+    return _watch(
+        _make_superepoch_fn(
+            per_step, mesh, n_arrays=1, residency=residency, monitor=monitor
+        ),
+        sentry,
+        "pretrain_superepoch",
+        steps_from_args=superepoch_steps_from_args(idx_pos),
+    )
 
 
 def _make_local_supervised_step(

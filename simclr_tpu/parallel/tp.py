@@ -54,6 +54,7 @@ from simclr_tpu.parallel.steps import (
     RESIDENCIES,
     _augment_two_views,
     _forward_fn,
+    _local_resident_block,
     _sharded_rows_global_batch,
 )
 from simclr_tpu.parallel.train_state import TrainState
@@ -298,3 +299,135 @@ def make_pretrain_epoch_fn_tp(
         )
 
     return jax.jit(epoch, donate_argnums=(0,))
+
+
+def make_pretrain_superepoch_fn_tp(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    temperature: float = 0.5,
+    strength: float = 0.5,
+    out_size: int = 32,
+    remat: bool = False,
+    residency: str = "replicated",
+    grad_allreduce: str = "exact",
+    monitor=None,
+) -> Callable[..., tuple[TrainState, dict]]:
+    """Superepoch-compiled TP training: an outer ``lax.scan`` over K epochs
+    around the :func:`make_pretrain_epoch_fn_tp` step scan, all at the JIT
+    level (the TP optimizer update needs GLOBAL arrays — module docstring).
+
+    Same calling convention as
+    :func:`simclr_tpu.parallel.steps.make_pretrain_superepoch_fn`:
+    ``(state, images_all, [train_labels, test_rows, test_labels,]
+    idx_super, [probe_mask,] base_key, step0) -> (state, stacked metrics)``
+    with ``idx_super`` the ``(K, steps, global_batch)`` index stack, RNG
+    folded on absolute step indices (``step0 + k*steps + i``), and — when
+    ``monitor`` is set — the in-program centroid probe gated per epoch by
+    ``probe_mask``. The probe re-enters ``shard_map`` with the TP param
+    specs; it only applies ``model.encode`` (encoder leaves are replicated
+    under TP), so the model-sharded head leaves pass through untouched.
+    """
+    if residency not in RESIDENCIES:
+        raise ValueError(
+            f"residency must be one of {RESIDENCIES}, got {residency!r}"
+        )
+    step = _make_step_body(
+        model, tx, mesh,
+        temperature=temperature, strength=strength, out_size=out_size,
+        remat=remat, grad_allreduce=grad_allreduce,
+    )
+    batched = NamedSharding(mesh, P(DATA_AXIS))
+    array_spec = P() if residency == "replicated" else P(DATA_AXIS)
+
+    def _local_batch_from_shards(local_rows, idx_step):
+        full = _sharded_rows_global_batch(local_rows, idx_step)
+        shard = jax.lax.axis_index(DATA_AXIS)
+        n_local = idx_step.shape[0] // axis_size(DATA_AXIS)
+        return jax.lax.dynamic_slice_in_dim(full, shard * n_local, n_local)
+
+    gather_sharded = shard_map(
+        _local_batch_from_shards,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P()),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+
+    def _probe(state, images_all, train_labels, test_rows, test_labels):
+        def local(params, batch_stats, imgs, tr_labels, te_rows, te_labels):
+            return monitor(
+                params, batch_stats,
+                _local_resident_block(imgs, residency), tr_labels,
+                _local_resident_block(te_rows, residency), te_labels,
+            )
+
+        sharded = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                tree_pspecs(state.params), tree_pspecs(state.batch_stats),
+                array_spec, P(), array_spec, P(),
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return sharded(
+            state.params, state.batch_stats, images_all,
+            train_labels, test_rows, test_labels,
+        )
+
+    def superepoch(state: TrainState, *rest):
+        images_all = rest[0]
+        if monitor is not None:
+            train_labels, test_rows, test_labels = rest[1:4]
+            idx_super, probe_mask, base_key, step0 = rest[4:]
+        else:
+            idx_super, base_key, step0 = rest[1:]
+        steps = idx_super.shape[1]
+
+        def step_body(state, xs):
+            idx_step, i = xs
+            if residency == "replicated":
+                batch = jax.lax.with_sharding_constraint(
+                    jnp.take(images_all, idx_step, axis=0), batched
+                )
+            else:
+                batch = gather_sharded(images_all, idx_step)
+            return step(state, batch, jax.random.fold_in(base_key, step0 + i))
+
+        def epoch_body(state, xs):
+            if monitor is not None:
+                idx_epoch, k, pm = xs
+            else:
+                idx_epoch, k = xs
+            offsets = k * steps + jnp.arange(steps, dtype=jnp.int32)
+            state, hist = jax.lax.scan(step_body, state, (idx_epoch, offsets))
+            if monitor is not None:
+                probe = jax.lax.cond(
+                    pm,
+                    lambda s: _probe(
+                        s, images_all, train_labels, test_rows, test_labels
+                    ),
+                    lambda s: {
+                        name: jnp.full((), jnp.nan, jnp.float32)
+                        for name in monitor.metric_names
+                    },
+                    state,
+                )
+                hist = dict(hist) | {
+                    f"monitor/{name}": v for name, v in probe.items()
+                }
+            return state, hist
+
+        n_epochs = idx_super.shape[0]
+        epoch_ids = jnp.arange(n_epochs, dtype=jnp.int32)
+        xs = (
+            (idx_super, epoch_ids, probe_mask)
+            if monitor is not None
+            else (idx_super, epoch_ids)
+        )
+        return jax.lax.scan(epoch_body, state, xs)
+
+    return jax.jit(superepoch, donate_argnums=(0,))

@@ -88,6 +88,15 @@ def _compute_dtype(cfg: Config):
 
 def run_supervised(cfg: Config) -> dict:
     check_supervised_conf(cfg)
+    if int(cfg.select("runtime.epochs_per_compile", 1) or 1) > 1:
+        # superepochs fold the pretrain monitor into the compiled program;
+        # the supervised loop validates/early-stops on host every epoch, so
+        # a K-epoch program has no correct place to put that logic
+        raise ValueError(
+            "runtime.epochs_per_compile > 1 (superepochs) applies to "
+            "contrastive pretraining only; supervised training validates "
+            "every epoch on host — set runtime.epochs_per_compile=1"
+        )
     seed = int(cfg.parameter.seed)
 
     mesh = mesh_from_config(cfg)

@@ -1,0 +1,480 @@
+"""Superepoch training (runtime.epochs_per_compile=K > 1).
+
+One XLA program per K EPOCHS (``parallel/steps.py:make_pretrain_superepoch_fn``,
+``parallel/tp.py:make_pretrain_superepoch_fn_tp``) with the dataset — and,
+when ``eval_every`` is on, the test split — resident in HBM. The contract
+under test:
+
+- a K-superepoch is numerically equivalent to K sequential single-epoch
+  calls (same index matrices, same absolute-step RNG folds), across both
+  dataset residencies, dp and dp×tp meshes, and exact/int8 grad_allreduce;
+- the in-program centroid monitor matches the host-side
+  ``eval.extract_features`` + ``eval.centroid_probe`` path on the same state;
+- the compiled program performs NO host transfers: with every input
+  device-resident, a full superepoch runs under
+  ``jax.transfer_guard("disallow")`` — host syncs happen only at superepoch
+  boundaries (the ISSUE's host-sync budget proof).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from simclr_tpu.data.pipeline import epoch_index_matrix
+from simclr_tpu.eval import centroid_probe, extract_features, make_local_centroid_monitor
+from simclr_tpu.ops.lars import lars, simclr_weight_decay_mask
+from simclr_tpu.parallel.mesh import (
+    DATA_AXIS,
+    create_mesh,
+    put_replicated,
+    put_row_sharded,
+    replicated_sharding,
+)
+from simclr_tpu.parallel.steps import (
+    check_epoch_compile_preconditions,
+    make_pretrain_epoch_fn,
+    make_pretrain_superepoch_fn,
+    superepoch_steps_from_args,
+)
+from simclr_tpu.parallel.train_state import create_train_state
+from tests.helpers import TinyContrastive, random_images
+
+GLOBAL_BATCH = 16
+DATASET = 32
+STEPS_PER_EPOCH = DATASET // GLOBAL_BATCH
+K = 4
+NUM_CLASSES = 10
+
+
+def _tx():
+    return lars(0.1, weight_decay=1e-4, weight_decay_mask=simclr_weight_decay_mask)
+
+
+def _init_state(model, tx, mesh):
+    state = create_train_state(
+        model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
+    )
+    return jax.device_put(state, replicated_sharding(mesh))
+
+
+def _put(images, mesh, residency):
+    if residency == "replicated":
+        return put_replicated(images, mesh)
+    return put_row_sharded(images, mesh)
+
+
+def _idx_super(n, seed, first_epoch, k):
+    return jnp.asarray(
+        np.stack([
+            epoch_index_matrix(n, seed, e, STEPS_PER_EPOCH, GLOBAL_BATCH)
+            for e in range(first_epoch, first_epoch + k)
+        ])
+    )
+
+
+def _pad_rows(a, mult):
+    pad = -len(a) % mult
+    if pad == 0:
+        return a
+    return np.concatenate([a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)])
+
+
+@pytest.mark.parametrize("residency", ["replicated", "sharded"])
+@pytest.mark.parametrize("mode", ["exact", "int8"])
+def test_superepoch_matches_single_epoch_calls(residency, mode):
+    """K-epoch superepoch == K sequential epoch_fn calls: same stacked loss
+    trajectory and final params (cross-program scan-fusion tolerances)."""
+    mesh = create_mesh()
+    model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
+    tx = _tx()
+    images = random_images(DATASET, seed=3)
+    images_all = _put(images, mesh, residency)
+    base_key = jax.random.key(11)
+
+    epoch_fn = make_pretrain_epoch_fn(
+        model, tx, mesh, temperature=0.5, strength=0.5,
+        residency=residency, grad_allreduce=mode,
+    )
+    state_a = _init_state(model, tx, mesh)
+    losses_a = []
+    cur = 0
+    for epoch in range(1, K + 1):
+        idx_e = jnp.asarray(
+            epoch_index_matrix(DATASET, 0, epoch, STEPS_PER_EPOCH, GLOBAL_BATCH)
+        )
+        state_a, hist = epoch_fn(state_a, images_all, idx_e, base_key, cur)
+        losses_a.extend(float(x) for x in hist["loss"])
+        cur += STEPS_PER_EPOCH
+
+    superepoch_fn = make_pretrain_superepoch_fn(
+        model, tx, mesh, temperature=0.5, strength=0.5,
+        residency=residency, grad_allreduce=mode,
+    )
+    state_b = _init_state(model, tx, mesh)
+    state_b, hist = superepoch_fn(
+        state_b, _put(images, mesh, residency), _idx_super(DATASET, 0, 1, K),
+        base_key, 0,
+    )
+    assert np.asarray(hist["loss"]).shape == (K, STEPS_PER_EPOCH)
+    losses_b = [float(x) for x in np.asarray(hist["loss"]).ravel()]
+
+    np.testing.assert_allclose(losses_a, losses_b, rtol=2e-3)
+    assert int(state_b.step) == K * STEPS_PER_EPOCH
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-3
+        ),
+        jax.device_get(state_a.params), jax.device_get(state_b.params),
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("residency", ["replicated", "sharded"])
+def test_superepoch_tp_matches_single_epoch_calls(residency):
+    """Same equivalence on a dp×tp (data=4, model=2) mesh: the TP superepoch
+    keeps its outer scan at jit level (LARS needs GLOBAL norms) but must
+    reproduce the TP single-epoch trajectory."""
+    from simclr_tpu.models.contrastive import ContrastiveModel
+    from simclr_tpu.parallel.mesh import MeshSpec
+    from simclr_tpu.parallel.tp import (
+        make_pretrain_epoch_fn_tp,
+        make_pretrain_superepoch_fn_tp,
+        tp_state_shardings,
+    )
+
+    mesh = create_mesh(MeshSpec(data=4, model=2))
+    model = ContrastiveModel(
+        base_cnn="resnet18", d=128, dtype=jnp.float32,
+        bn_cross_replica_axis=DATA_AXIS,
+    )
+    tx = _tx()
+
+    def fresh_state():
+        s = create_train_state(
+            model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
+        )
+        return jax.device_put(s, tp_state_shardings(mesh, s))
+
+    k = 2
+    images = random_images(DATASET, seed=5)
+    base_key = jax.random.key(42)
+
+    epoch_fn = make_pretrain_epoch_fn_tp(model, tx, mesh, residency=residency)
+    state_a = fresh_state()
+    losses_a = []
+    cur = 0
+    for epoch in range(1, k + 1):
+        idx_e = jnp.asarray(
+            epoch_index_matrix(DATASET, 0, epoch, STEPS_PER_EPOCH, GLOBAL_BATCH)
+        )
+        state_a, hist = epoch_fn(
+            state_a, _put(images, mesh, residency), idx_e, base_key, cur
+        )
+        losses_a.extend(float(x) for x in hist["loss"])
+        cur += STEPS_PER_EPOCH
+
+    superepoch_fn = make_pretrain_superepoch_fn_tp(
+        model, tx, mesh, residency=residency
+    )
+    state_b, hist = superepoch_fn(
+        fresh_state(), _put(images, mesh, residency),
+        _idx_super(DATASET, 0, 1, k), base_key, 0,
+    )
+    losses_b = [float(x) for x in np.asarray(hist["loss"]).ravel()]
+
+    # float32 model: both paths run the identical per-step program; only
+    # scan-nesting fusion order differs
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4
+        ),
+        jax.device_get(state_a.params), jax.device_get(state_b.params),
+    )
+
+
+@pytest.mark.parametrize("residency", ["replicated", "sharded"])
+def test_in_program_monitor_matches_host_probe(residency):
+    """The compiled-in centroid monitor reports the same accuracies as the
+    host-side extract_features + centroid_probe on the post-epoch state.
+    Row counts are chosen NOT to divide the shard count, so the padded
+    upload + by-position validity masking is exercised."""
+    mesh = create_mesh()
+    n_data = mesh.shape[DATA_AXIS]
+    model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
+    tx = _tx()
+    rng = np.random.default_rng(0)
+    n_train, n_test = 36, 20  # 36 % 8 == 4, 20 % 8 == 4: padding is real
+    train_images = random_images(n_train, seed=1)
+    test_images = random_images(n_test, seed=2)
+    train_labels = rng.integers(0, NUM_CLASSES, size=n_train).astype(np.int32)
+    test_labels = rng.integers(0, NUM_CLASSES, size=n_test).astype(np.int32)
+
+    probe = make_local_centroid_monitor(
+        model, num_classes=NUM_CLASSES, n_train=n_train, n_test=n_test,
+        top_k=5, chunk=4,
+    )
+    superepoch_fn = make_pretrain_superepoch_fn(
+        model, tx, mesh, temperature=0.5, strength=0.5,
+        residency=residency, monitor=probe,
+    )
+    state = _init_state(model, tx, mesh)
+    idx = jnp.asarray(
+        np.stack([
+            epoch_index_matrix(n_train, 0, e, 2, GLOBAL_BATCH) for e in (1, 2)
+        ])
+    )
+    train_rows = (
+        _pad_rows(train_images, n_data) if residency == "replicated"
+        else train_images
+    )
+    test_rows = (
+        _pad_rows(test_images, n_data) if residency == "replicated"
+        else test_images
+    )
+    state, hist = superepoch_fn(
+        state,
+        _put(train_rows, mesh, residency),
+        put_replicated(_pad_rows(train_labels, n_data), mesh),
+        _put(test_rows, mesh, residency),
+        put_replicated(_pad_rows(test_labels, n_data), mesh),
+        idx,
+        jnp.asarray([False, True]),  # eval_every predicate per epoch
+        jax.random.key(11),
+        0,
+    )
+    mon = {k: np.asarray(v) for k, v in hist.items() if k.startswith("monitor/")}
+    assert set(mon) == {
+        "monitor/train_acc", "monitor/train_top_5_acc",
+        "monitor/val_acc", "monitor/val_top_5_acc",
+    }
+    # unprobed epochs carry NaN (the lax.cond skip branch), probed are real
+    for v in mon.values():
+        assert v.shape == (2,)
+        assert np.isnan(v[0]) and np.isfinite(v[1])
+
+    variables = jax.device_get(
+        {"params": state.params, "batch_stats": state.batch_stats}
+    )
+    train_X = extract_features(
+        model, variables, train_images, mesh, GLOBAL_BATCH, False
+    )
+    val_X = extract_features(
+        model, variables, test_images, mesh, GLOBAL_BATCH, False
+    )
+    host = centroid_probe(
+        train_X, train_labels, val_X, test_labels, NUM_CLASSES, top_k=5
+    )
+    # correct counts are integer sums: exact agreement unless feature-level
+    # float drift flips an argmax tie
+    for name, want in host.items():
+        np.testing.assert_allclose(
+            float(mon[f"monitor/{name}"][1]), want, atol=0.02, err_msg=name
+        )
+
+
+@pytest.mark.parametrize("with_monitor", [False, True])
+def test_superepoch_runs_without_host_transfers(with_monitor):
+    """The host-sync budget proof: with every input device-resident, a full
+    K-epoch superepoch (steps + probes) executes under
+    ``jax.transfer_guard("disallow")`` — the program itself never crosses
+    the host boundary; transfers happen only at superepoch boundaries."""
+    mesh = create_mesh()
+    n_data = mesh.shape[DATA_AXIS]
+    model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
+    tx = _tx()
+    rng = np.random.default_rng(0)
+    n_test = 16
+    train_labels = rng.integers(0, NUM_CLASSES, size=DATASET).astype(np.int32)
+    test_labels = rng.integers(0, NUM_CLASSES, size=n_test).astype(np.int32)
+
+    probe = (
+        make_local_centroid_monitor(
+            model, num_classes=NUM_CLASSES, n_train=DATASET, n_test=n_test,
+            top_k=5, chunk=8,
+        )
+        if with_monitor else None
+    )
+    superepoch_fn = make_pretrain_superepoch_fn(
+        model, tx, mesh, temperature=0.5, strength=0.5, monitor=probe
+    )
+    # EVERYTHING device-resident up front — a python int or host numpy array
+    # in the call would itself be an implicit transfer and fail the guard
+    state = _init_state(model, tx, mesh)
+    rep = replicated_sharding(mesh)
+    images_all = put_replicated(random_images(DATASET, seed=3), mesh)
+    idx = jax.device_put(_idx_super(DATASET, 0, 1, K), rep)
+    base_key = jax.device_put(jax.random.key(11), rep)
+    step0 = jax.device_put(jnp.zeros((), jnp.int32), rep)
+    if with_monitor:
+        args = (
+            state, images_all,
+            put_replicated(_pad_rows(train_labels, n_data), mesh),
+            put_replicated(random_images(n_test, seed=4), mesh),
+            put_replicated(_pad_rows(test_labels, n_data), mesh),
+            idx,
+            jax.device_put(jnp.asarray([True, False, True, False]), rep),
+            base_key, step0,
+        )
+    else:
+        args = (state, images_all, idx, base_key, step0)
+    superepoch_fn(*args)  # warm: compilation reads host constants freely
+    state2 = _init_state(model, tx, mesh)
+    with jax.transfer_guard("disallow"):
+        state2, hist = superepoch_fn(state2, *args[1:])
+    losses = np.asarray(hist["loss"])  # boundary fetch, OUTSIDE the guard
+    assert losses.shape == (K, STEPS_PER_EPOCH)
+    assert np.isfinite(losses).all()
+
+
+def test_superepoch_steps_from_args():
+    idx = jnp.zeros((3, 5, 16), jnp.int32)
+    assert superepoch_steps_from_args(2)((None, None, idx, None, None)) == 15
+    assert superepoch_steps_from_args(5)(
+        (None, None, None, None, None, idx, None, None, None)
+    ) == 15
+
+
+def test_preflight_accounts_superepoch_residency():
+    """The HBM preflight charges the K-epoch index tensor and the resident
+    probe split before comparing against the budget."""
+    n, batch, steps = 1024, 64, 16
+    row = 32 * 32 * 3  # uint8 bytes per row
+    dataset_bytes = n * row
+    probe_samples = 256
+    probe_bytes = probe_samples * row
+    # budget that fits the dataset alone but NOT dataset + probe + K=10 index
+    budget = dataset_bytes + probe_bytes // 2
+
+    base = check_epoch_compile_preconditions(
+        n, batch, dataset_bytes=dataset_bytes, hbm_budget_bytes=budget
+    )
+    assert base == dataset_bytes
+
+    with pytest.raises(ValueError, match="HBM budget"):
+        check_epoch_compile_preconditions(
+            n, batch, dataset_bytes=dataset_bytes, hbm_budget_bytes=budget,
+            epochs_per_compile=10, steps_per_epoch=steps,
+            probe_bytes=probe_bytes, probe_samples=probe_samples,
+        )
+
+    # sharded residency divides BOTH the dataset and probe rows per shard
+    got = check_epoch_compile_preconditions(
+        n, batch, dataset_bytes=dataset_bytes, hbm_budget_bytes=budget,
+        n_data_shards=8, residency="sharded",
+        epochs_per_compile=10, steps_per_epoch=steps,
+        probe_bytes=probe_bytes, probe_samples=probe_samples,
+    )
+    assert got == (n // 8) * row + (probe_samples // 8) * row + 10 * steps * batch * 4
+
+    with pytest.raises(ValueError, match="epochs_per_compile"):
+        check_epoch_compile_preconditions(n, batch, epochs_per_compile=0)
+
+
+def test_config_rejects_bad_epochs_per_compile():
+    from simclr_tpu.config import ConfigError, check_pretrain_conf, load_config
+
+    base = [
+        "experiment.synthetic_data=true",
+        "experiment.synthetic_size=64",
+        "experiment.batches=4",
+    ]
+    with pytest.raises(ConfigError, match="epochs_per_compile"):
+        check_pretrain_conf(
+            load_config("config", overrides=base + ["runtime.epochs_per_compile=0"])
+        )
+    # K > 1 without the epoch scan it nests in is a contradiction
+    with pytest.raises(ConfigError, match="epoch_compile"):
+        check_pretrain_conf(
+            load_config("config", overrides=base + ["runtime.epochs_per_compile=2"])
+        )
+    check_pretrain_conf(
+        load_config(
+            "config",
+            overrides=base
+            + ["runtime.epoch_compile=true", "runtime.epochs_per_compile=2"],
+        )
+    )
+
+
+def test_supervised_rejects_superepochs():
+    from simclr_tpu.config import load_config
+    from simclr_tpu.supervised import run_supervised
+
+    cfg = load_config(
+        "supervised_config",
+        overrides=[
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=64",
+            "experiment.batches=4",
+            "runtime.epoch_compile=true",
+            "runtime.epochs_per_compile=2",
+        ],
+    )
+    with pytest.raises(ValueError, match="pretraining only"):
+        run_supervised(cfg)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("residency", ["replicated", "sharded"])
+def test_superepoch_entrypoint(tmp_path, residency):
+    """run_pretrain end to end with K=2 over 5 epochs: two full superepochs
+    + one tail epoch on the single-epoch program. Per-epoch rows must be
+    preserved exactly as K=1 produces them: 5 loss rows, monitor rows for
+    the epoch-0/2/4 probes plus the final epoch, boundary checkpoints."""
+    import json
+
+    from simclr_tpu.config import load_config
+    from simclr_tpu.main import run_pretrain
+
+    cfg = load_config(
+        "config",
+        overrides=[
+            "parameter.epochs=5",
+            "experiment.batches=4",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=2",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=72",  # 72 % (8 data shards) != 0 pads
+            "experiment.eval_every=2",
+            "runtime.epoch_compile=true",
+            "runtime.epochs_per_compile=2",
+            f"runtime.dataset_residency={residency}",
+            f"experiment.save_dir={tmp_path}",
+        ],
+    )
+    summary = run_pretrain(cfg)
+    steps_per_epoch = 72 // (4 * 8)
+    assert summary["steps"] == 5 * steps_per_epoch
+    assert np.isfinite(summary["final_loss"])
+    assert [r[0] for r in summary["loss_history"]] == [1, 2, 3, 4, 5]
+    assert all(np.isfinite(r[1]) for r in summary["loss_history"])
+    # epoch 0 = host random-init anchor; 2, 4 = in-program probes; 5 = final
+    # epoch, a tail epoch probed on host
+    assert [r[0] for r in summary["monitor_history"]] == [0, 2, 4, 5]
+    assert all(np.isfinite(r[1]) for r in summary["monitor_history"])
+    res = json.loads((tmp_path / "pretrain_results.json").read_text())
+    assert res["complete"] is True
+    assert (tmp_path / "epoch=5-cifar10").exists()
+
+    # a checkpoint OFF the K grid cannot seed a superepoch resume
+    cfg2 = load_config(
+        "config",
+        overrides=[
+            "parameter.epochs=7",
+            "experiment.batches=4",
+            "parameter.warmup_epochs=0",
+            "experiment.save_model_epoch=2",
+            "experiment.synthetic_data=true",
+            "experiment.synthetic_size=72",
+            "runtime.epoch_compile=true",
+            "runtime.epochs_per_compile=3",
+            "experiment.resume=true",
+            f"runtime.dataset_residency={residency}",
+            f"experiment.save_dir={tmp_path}",
+        ],
+    )
+    with pytest.raises(ValueError, match="mid-superepoch"):
+        run_pretrain(cfg2)

@@ -21,7 +21,8 @@ WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "remat2048", "explore1024", "explore512",
-    "supervisor_smoke", "obs_smoke", "compile_audit", "run_report",
+    "supervisor_smoke", "obs_smoke", "compile_audit", "superepoch",
+    "run_report",
 )
 
 
@@ -83,6 +84,12 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         "echo 'simclr_train_imgs_per_sec 12345.6'; "
         "echo 'simclr_train_compiles_total 3'; "
         "echo 'simclr_train_recompile_alarms_total 0';; esac",
+        # the superepoch stage greps for all three evidence lines: parity
+        # OK, a positive compile counter, and a zero recompile-alarm counter
+        'case "$*" in *superepoch_smoke.py*) '
+        "echo 'superepoch_parity OK k=4 max_rel_loss_diff=1.20e-04'; "
+        "echo 'superepoch_compiles_total 2'; "
+        "echo 'superepoch_recompile_alarms_total 0';; esac",
         # the run_report stage greps for a COMPUTED verdict (OK|REGRESSION):
         # a NO_DATA/NO_BASELINE report exits 0 but proves nothing
         'case "$*" in *simclr_tpu.obs.report*) '
@@ -231,6 +238,33 @@ def test_compile_audit_marker_requires_quiet_sentry(tmp_path):
     assert "obs_smoke" in _done(state)
     assert (state / "compile_audit.fails").exists()
     assert "stage compile_audit FAILED" in log.read_text()
+
+
+def test_superepoch_marker_requires_parity_and_quiet_sentry(tmp_path):
+    """The superepoch done-marker needs all three evidence lines: a K>1
+    program that diverges from the single-epoch trajectory (parity FAIL) or
+    a repeat call that recompiled must not earn superepoch.done — and the
+    stages sharing the window must be untouched."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        "superepoch_parity OK k=4", "superepoch_parity FAIL k=4"))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "superepoch" not in _done(state)
+    assert "compile_audit" in _done(state)
+    assert (state / "superepoch.fails").exists()
+    assert "stage superepoch FAILED" in log.read_text()
+
+    # second contract: parity OK but a recompile alarm fired mid-smoke
+    stub.write_text(stub.read_text()
+                    .replace("superepoch_parity FAIL k=4",
+                             "superepoch_parity OK k=4")
+                    .replace("superepoch_recompile_alarms_total 0",
+                             "superepoch_recompile_alarms_total 1"))
+    (state / "superepoch.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "superepoch" not in _done(state)
+    assert (state / "superepoch.fails").exists()
 
 
 def test_run_report_marker_requires_computed_verdict(tmp_path):

@@ -20,6 +20,15 @@ even from a CPU run; ms/step carries the measured side and names its
 backend. On a multichip TPU run this is the ``allreduce_bench`` stage of
 ``scripts/tpu_watch.sh``.
 
+With ``--overlap`` (or ``ALLREDUCE_BENCH_OVERLAP=1``) every mode entry also
+carries an ``"overlap"`` table — ms/step and analytic ring wire bytes per
+chunk count (``parallel.comm_overlap=chunked``), the on/off columns the
+ROADMAP's pod-scaling item asks for:
+
+    "modes": {"int8": {"ms_per_step": ..., "wire_mb_per_device": ...,
+                       "overlap": {"4": {"ms_per_step": ...,
+                                         "wire_mb_per_device": ...}, ...}}}
+
 Robustness contract (same as bench.py / serve_bench.py): never exits
 nonzero, never ends on a traceback, emits EXACTLY ONE payload line; a
 wall-clock budget drops unfinished (model, mode) pairs LOUDLY under
@@ -28,7 +37,9 @@ wall-clock budget drops unfinished (model, mode) pairs LOUDLY under
 Env knobs: ``ALLREDUCE_BENCH_SIZES`` (``name=n_elements,...`` — bypasses
 model tracing; the fast tests use a tiny size), ``ALLREDUCE_BENCH_MODES``
 (default ``exact,bf16,int8``), ``ALLREDUCE_BENCH_ITERS`` (default 10),
-``ALLREDUCE_BENCH_BUDGET_S`` (default 600).
+``ALLREDUCE_BENCH_BUDGET_S`` (default 600), ``ALLREDUCE_BENCH_OVERLAP``
+(truthy = same as ``--overlap``), ``ALLREDUCE_BENCH_CHUNKS`` (chunk counts
+for the overlap table, default ``2,4,8``).
 """
 
 from __future__ import annotations
@@ -42,6 +53,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DEFAULT_MODES = "exact,bf16,int8"
+DEFAULT_OVERLAP_CHUNKS = "2,4,8"
 DEFAULT_ITERS = 10
 WARMUP_ITERS = 2
 DEFAULT_BUDGET_S = 600.0
@@ -116,7 +128,10 @@ def gradient_sizes() -> dict[str, int]:
     return out
 
 
-def bench_mode(mesh, n_elements: int, mode: str, iters: int) -> float:
+def bench_mode(
+    mesh, n_elements: int, mode: str, iters: int,
+    overlap: str = "off", chunks: int = 1,
+) -> float:
     """Median ms per grad_allreduce step on a flat vector of ``n_elements``."""
     import jax
     import jax.numpy as jnp
@@ -129,7 +144,7 @@ def bench_mode(mesh, n_elements: int, mode: str, iters: int) -> float:
         i = jax.lax.axis_index(DATA_AXIS)
         key = jax.random.fold_in(jax.random.fold_in(jax.random.key(0), step), i)
         return compress.grad_allreduce(
-            {"g": x}, DATA_AXIS, mode, key=key
+            {"g": x}, DATA_AXIS, mode, key=key, overlap=overlap, chunks=chunks
         )["g"]
 
     fn = jax.jit(
@@ -195,6 +210,16 @@ def main() -> None:
         if m.strip()
     ]
     iters = int(os.environ.get("ALLREDUCE_BENCH_ITERS", DEFAULT_ITERS))
+    overlap_on = "--overlap" in sys.argv[1:] or bool(
+        os.environ.get("ALLREDUCE_BENCH_OVERLAP")
+    )
+    chunk_counts = [
+        int(c)
+        for c in os.environ.get(
+            "ALLREDUCE_BENCH_CHUNKS", DEFAULT_OVERLAP_CHUNKS
+        ).split(",")
+        if c.strip()
+    ] if overlap_on else []
     mesh = create_mesh(MeshSpec(data=-1, model=1))
     n_dev = len(jax.devices())
     extra = {
@@ -203,6 +228,8 @@ def main() -> None:
         "bucket_size": DEFAULT_BUCKET_SIZE,
         "iters": iters,
     }
+    if overlap_on:
+        extra["overlap_chunks"] = chunk_counts
 
     sizes = gradient_sizes()
     models: dict[str, dict] = {}
@@ -222,6 +249,29 @@ def main() -> None:
                 ),
             }
             print(f"# {name}/{mode}: {ms:.3f} ms/step", file=sys.stderr)
+            # overlap on/off columns: the chunked ring at each chunk count,
+            # next to the single-shot number above (off). Same budget
+            # discipline per (model, mode, chunks) triple.
+            for c in chunk_counts:
+                if time.monotonic() > deadline - EMIT_RESERVE_S:
+                    skipped.append(f"{name}/{mode}/chunks={c}")
+                    continue
+                ms_c = bench_mode(
+                    mesh, n_elements, mode, iters, overlap="chunked", chunks=c
+                )
+                entry["modes"][mode].setdefault("overlap", {})[str(c)] = {
+                    "ms_per_step": round(ms_c, 3),
+                    "wire_mb_per_device": round(
+                        allreduce_wire_bytes(
+                            n_elements, n_dev, mode,
+                            overlap="chunked", chunks=c,
+                        ) / 2**20, 3
+                    ),
+                }
+                print(
+                    f"# {name}/{mode}/chunks={c}: {ms_c:.3f} ms/step",
+                    file=sys.stderr,
+                )
         if entry["modes"]:
             models[name] = entry
         else:

@@ -1,0 +1,189 @@
+"""Multi-host dryrun: 2-process CPU rendezvous + chunked-ring parity check.
+
+Proves the multi-host path end to end WITHOUT a pod: spawns a real
+2-process jobs via ``simclr_tpu.launch`` (coordinator rendezvous over
+``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``,
+4 forced-CPU devices per process), runs the ``simclr_tpu.multihost_dryrun``
+worker on the resulting 8-device global mesh, then runs the SAME worker
+single-process on 8 devices and compares checksums. The worker exercises
+rendezvous, ``put_row_sharded`` residency upload (each process feeds only
+its addressable rows), and ``grad_allreduce(..., overlap="chunked")``
+(int8 ring, non-divisible chunk count) — so bitwise parity here means the
+multi-host code path computes exactly what the single-process path does.
+
+ONE JSON payload line:
+
+    {"metric": "multihost_dryrun_parity", "value": 1.0, "unit": "bool",
+     "process_count": 2, "parity": true,
+     "multi": {...worker line...}, "single": {...worker line...}}
+
+On a TPU host this is the ``multihost_dryrun`` stage of
+``scripts/tpu_watch.sh``; its done-marker requires ``"process_count": 2``
+and ``"parity": true``. Robustness contract (same as allreduce_bench.py):
+never exits nonzero, never ends on a traceback, emits EXACTLY ONE payload
+line; failures land in an ``"error"`` field.
+
+Env knobs: ``MULTIHOST_DRYRUN_TIMEOUT_S`` (per-phase subprocess timeout,
+default 300), ``MULTIHOST_DRYRUN_COORD_TIMEOUT_S`` (rendezvous fail-fast
+deadline exported as ``JAX_COORDINATOR_TIMEOUT_S``, default 60).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+WORKER_MODULE = "simclr_tpu.multihost_dryrun"
+NPROCS = 2
+DEVICES_PER_PROC = 4
+
+_PAYLOAD_EMITTED = False
+
+
+def _emit_payload(payload: dict) -> None:
+    """Print the run's single payload line, exactly once (bench.py contract)."""
+    global _PAYLOAD_EMITTED
+    if _PAYLOAD_EMITTED:
+        return
+    _PAYLOAD_EMITTED = True
+    print(json.dumps(payload), flush=True)
+
+
+def last_ditch_payload(exc: BaseException) -> dict:
+    return {
+        "metric": "multihost_dryrun_parity",
+        "value": 0.0,
+        "unit": "bool",
+        "parity": False,
+        "error": repr(exc),
+    }
+
+
+def _sigterm_backstop(signum, frame) -> None:
+    if not _PAYLOAD_EMITTED:
+        _emit_payload(
+            last_ditch_payload(
+                RuntimeError(f"terminated by signal {signum} before finishing")
+            )
+        )
+    os._exit(0)
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_worker_line(stdout: str, label: str) -> dict:
+    """The worker prints one JSON line from process 0; find it."""
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if obj.get("worker") == "multihost_dryrun":
+                return obj
+    raise RuntimeError(f"{label}: no worker payload line in output")
+
+
+def _run(cmd: list[str], env: dict, timeout_s: float, label: str) -> dict:
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout_s,
+        cwd=REPO_ROOT,
+    )
+    # surface worker stderr for the watcher log, prefixed as commentary
+    for line in proc.stderr.splitlines()[-20:]:
+        print(f"# [{label}] {line}", file=sys.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{label} exited {proc.returncode}; last stderr: "
+            f"{proc.stderr.strip().splitlines()[-1] if proc.stderr.strip() else '<empty>'!r}"
+        )
+    return _parse_worker_line(proc.stdout, label)
+
+
+def main() -> None:
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_backstop)
+    except ValueError:  # non-main thread (embedded runs)
+        pass
+    timeout_s = float(os.environ.get("MULTIHOST_DRYRUN_TIMEOUT_S", 300))
+    coord_timeout = os.environ.get("MULTIHOST_DRYRUN_COORD_TIMEOUT_S", "60")
+
+    base_env = {
+        k: v
+        for k, v in os.environ.items()
+        # scrub any ambient rendezvous config so each phase fully controls it
+        if k
+        not in (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "JAX_NUM_PROCESSES",
+            "JAX_PROCESS_ID",
+            "JAX_PLATFORMS",
+            "XLA_FLAGS",
+        )
+    }
+    # a wedged coordinator fails in ~1 min, not jax's 5-minute default
+    base_env["JAX_COORDINATOR_TIMEOUT_S"] = coord_timeout
+
+    # phase 1: real 2-process rendezvous, 4 CPU devices each => 8 global
+    multi_cmd = [
+        sys.executable, "-m", "simclr_tpu.launch",
+        "--nprocs", str(NPROCS),
+        "--coordinator", f"127.0.0.1:{_free_port()}",
+        "--devices-per-proc", str(DEVICES_PER_PROC),
+        "-m", WORKER_MODULE,
+    ]
+    multi = _run(multi_cmd, base_env, timeout_s, "multi")
+
+    # phase 2: single-process reference on the same 8-device global mesh
+    single_env = dict(base_env)
+    single_env["JAX_PLATFORMS"] = "cpu"
+    single_env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={NPROCS * DEVICES_PER_PROC}"
+    )
+    single = _run(
+        [sys.executable, "-m", WORKER_MODULE], single_env, timeout_s, "single"
+    )
+
+    rows_ok = all(
+        w["local_rows"] == w["expected_local_rows"] for w in (multi, single)
+    )
+    parity = (
+        multi["process_count"] == NPROCS
+        and multi["n_devices"] == single["n_devices"]
+        and multi["checksum"] == single["checksum"]  # bitwise, no tolerance
+        and rows_ok
+    )
+    payload = {
+        "metric": "multihost_dryrun_parity",
+        "value": 1.0 if parity else 0.0,
+        "unit": "bool",
+        "process_count": multi["process_count"],
+        "parity": parity,
+        "multi": multi,
+        "single": single,
+    }
+    if not parity:
+        payload["error"] = "multi-process run diverged from single-process run"
+    _emit_payload(payload)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as exc:  # last-ditch contract keeper: one line, rc 0
+        print(f"# unexpected error: {exc!r}", file=sys.stderr)
+        _emit_payload(last_ditch_payload(exc))
+    sys.exit(0)

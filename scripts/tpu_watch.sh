@@ -48,10 +48,13 @@ MAX_STAGE_FAILS=3
 # (training + eval_every monitor on the real chip), then a bench refresh
 # (keeps the committed capture young, see bench.py provenance decay),
 # then the collective wire-format microbench (zero on-chip numbers yet —
-# PERF.md's compressed-collectives rows are pending on it), then the
-# remaining step matrices, and last the supervisor kill/resume smoke
-# (fault tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch run_report"
+# PERF.md's compressed-collectives rows are pending on it; runs with
+# --overlap so the chunked-ring on/off columns land in the same window),
+# then the 2-process multihost rendezvous/parity dryrun (CPU-backed, no
+# chip lock — proves the pod code path on the host), then the remaining
+# step matrices, and last the supervisor kill/resume smoke (fault
+# tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench multihost_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -181,17 +184,41 @@ run_stage() {
             rc=$? ;;
         allreduce_bench)
             # grad all-reduce wire-format microbench (exact/bf16/int8,
-            # scripts/allreduce_bench.py). The script exits 0 even on
-            # error (bench.py robustness contract), so rc alone proves
-            # nothing: only an error-free payload line counts as
-            # collected evidence.
+            # scripts/allreduce_bench.py), run with --overlap so the
+            # payload carries the chunked-ring ms/step columns next to the
+            # single-shot numbers. The script exits 0 even on error
+            # (bench.py robustness contract), so rc alone proves nothing:
+            # only an error-free payload line WITH an overlap table counts
+            # as collected evidence (a budget-starved run that skipped
+            # every chunked pair must retry next window).
             out="$STATE/allreduce_bench.out"
             run_locked "$(stage_timeout 900)" python scripts/allreduce_bench.py \
-                > "$out" 2>&1
+                --overlap > "$out" 2>&1
             rc=$?
             cat "$out" >> "$LOG"
             if [ "$rc" -eq 0 ]; then
                 grep -q '"metric": "allreduce_wire_reduction' "$out" \
+                    && grep -q '"overlap"' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        multihost_dryrun)
+            # multi-host rendezvous + chunked-ring parity e2e
+            # (scripts/multihost_dryrun.py): a REAL 2-process
+            # jax.distributed rendezvous over localhost, forced-CPU
+            # devices, must reproduce the single-process checksum bitwise.
+            # CPU-only by construction — no chip lock needed (like
+            # run_report); the orchestrator itself never imports jax. Its
+            # script also exits 0 on error, so the done marker requires a
+            # 2-process parity payload with no error field.
+            out="$STATE/multihost_dryrun.out"
+            timeout "$(stage_timeout 900)" python scripts/multihost_dryrun.py \
+                > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"process_count": 2' "$out" \
+                    && grep -q '"parity": true' "$out" \
                     && ! grep -q '"error"' "$out"
                 rc=$?
             fi ;;

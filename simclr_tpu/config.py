@@ -605,14 +605,33 @@ def _check_supervisor_conf(cfg: Config) -> None:
 
 
 def _check_parallel_conf(cfg: Config) -> None:
-    # single source of truth for the valid set: parallel/compress.py
-    from simclr_tpu.parallel.compress import GRAD_ALLREDUCE_MODES
+    # single source of truth for the valid sets/ranges: parallel/compress.py
+    from simclr_tpu.parallel.compress import (
+        COMM_OVERLAP_MODES,
+        DEFAULT_COMM_CHUNKS,
+        GRAD_ALLREDUCE_MODES,
+        MAX_COMM_CHUNKS,
+        normalize_overlap,
+    )
 
     mode = cfg.select("parallel.grad_allreduce", "exact")
     _require(
         mode in GRAD_ALLREDUCE_MODES,
         f"parallel.grad_allreduce must be one of {GRAD_ALLREDUCE_MODES}, "
         f"got {mode!r}",
+    )
+    overlap = normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
+    _require(
+        overlap in COMM_OVERLAP_MODES,
+        f"parallel.comm_overlap must be one of {COMM_OVERLAP_MODES}, "
+        f"got {overlap!r}",
+    )
+    chunks = cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
+    _require(
+        isinstance(chunks, int) and not isinstance(chunks, bool)
+        and 1 <= chunks <= MAX_COMM_CHUNKS,
+        f"parallel.comm_chunks must be an int in [1, {MAX_COMM_CHUNKS}], "
+        f"got {chunks!r}",
     )
 
 

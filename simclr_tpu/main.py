@@ -45,6 +45,7 @@ from simclr_tpu.obs.events import EventLog
 from simclr_tpu.obs.exporter import maybe_start_exporter
 from simclr_tpu.obs.telemetry import Telemetry
 from simclr_tpu.ops.lars import get_weight_decay_mask, lars
+from simclr_tpu.parallel.compress import DEFAULT_COMM_CHUNKS, normalize_overlap
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -52,6 +53,7 @@ from simclr_tpu.parallel.mesh import (
     mesh_from_config,
     put_replicated,
     put_row_sharded,
+    put_tree,
     replicated_sharding,
     validate_per_device_batch,
 )
@@ -165,9 +167,9 @@ def run_pretrain(cfg: Config) -> dict:
         # restore template, so resume keeps the layout
         from simclr_tpu.parallel.tp import tp_state_shardings
 
-        state = jax.device_put(state, tp_state_shardings(mesh, state))
+        state = put_tree(state, tp_state_shardings(mesh, state))
     else:
-        state = jax.device_put(state, replicated_sharding(mesh))
+        state = put_tree(state, replicated_sharding(mesh))
 
     save_dir = resolve_save_dir(cfg)
     # run telemetry (simclr_tpu/obs/, docs/OBSERVABILITY.md): metric
@@ -252,6 +254,15 @@ def run_pretrain(cfg: Config) -> dict:
         # all-reduce — exact | bf16 | int8 (parallel/compress.py,
         # docs/PERF.md §"Compressed collectives")
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+        # parallel.comm_overlap / comm_chunks: collective schedule — "chunked"
+        # splits the all-reduce into N ppermute rings XLA overlaps with the
+        # backward (docs/PERF.md §"Overlapped collectives")
+        comm_overlap=str(
+            normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
+        ),
+        comm_chunks=int(
+            cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
+        ),
         # obs/compile.py recompile sentry: the builders route the jitted
         # step through an instrumented AOT lower/compile path when set
         sentry=sentry,
@@ -386,6 +397,8 @@ def run_pretrain(cfg: Config) -> dict:
                 remat=step_kwargs["remat"],
                 residency=residency,
                 grad_allreduce=step_kwargs["grad_allreduce"],
+                comm_overlap=step_kwargs["comm_overlap"],
+                comm_chunks=step_kwargs["comm_chunks"],
             )
             if sentry is not None:
                 # the TP builders predate the sentry kwarg; wrap at the
@@ -405,6 +418,8 @@ def run_pretrain(cfg: Config) -> dict:
                     remat=step_kwargs["remat"],
                     residency=residency,
                     grad_allreduce=step_kwargs["grad_allreduce"],
+                    comm_overlap=step_kwargs["comm_overlap"],
+                    comm_chunks=step_kwargs["comm_chunks"],
                     monitor=probe_local,
                 )
                 if sentry is not None:
@@ -430,6 +445,8 @@ def run_pretrain(cfg: Config) -> dict:
                 strength=step_kwargs["strength"],
                 remat=step_kwargs["remat"],
                 grad_allreduce=step_kwargs["grad_allreduce"],
+                comm_overlap=step_kwargs["comm_overlap"],
+                comm_chunks=step_kwargs["comm_chunks"],
             )
             if sentry is not None:
                 step_fn = sentry.watch(step_fn, "pretrain_step")

@@ -197,6 +197,39 @@ def put_row_sharded(array, mesh: Mesh) -> jax.Array:
     )
 
 
+def put_tree(tree, shardings):
+    """Place a host-computed pytree onto per-leaf shardings, multi-host safe.
+
+    The state-placement counterpart of :func:`put_replicated`. Single
+    process: plain ``jax.device_put``. Multi-process: ``device_put`` onto a
+    non-fully-addressable sharding runs jax's per-leaf cross-process
+    equality check, and a train-state pytree is dozens of differently-sized
+    leaves — on the gloo CPU backend those back-to-back differently-sized
+    broadcasts race in the TCP pairs and abort the process (``pair.cc``
+    enforce ``op.preamble.length <= op.nbytes``). State is derived from the
+    shared seed identically on every process, so the check buys nothing:
+    build each leaf with ``make_array_from_callback`` instead (this process
+    fills only the shards it addresses — zero cross-process traffic).
+    Divergent per-process state would surface loudly as diverging losses,
+    the same failure mode as divergent index matrices.
+
+    ``shardings`` is a matching pytree of shardings (or a single sharding
+    applied to every leaf).
+    """
+    if isinstance(shardings, jax.sharding.Sharding):
+        shardings = jax.tree.map(lambda _: shardings, tree)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+
+    def place(x, s):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, s, lambda idx, a=arr: a[idx]
+        )
+
+    return jax.tree.map(place, tree, shardings)
+
+
 def process_local_rows(n_global_rows: int) -> slice:
     """This process's contiguous row block of a batch of ``n_global_rows``.
 

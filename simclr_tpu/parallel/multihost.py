@@ -86,6 +86,31 @@ def maybe_initialize_multihost() -> bool:
             "num_processes": num_processes,
             "process_id": int(os.environ.get("JAX_PROCESS_ID", "0")),
         }
+        # JAX_COORDINATOR_TIMEOUT_S: rendezvous deadline in seconds. jax's
+        # default initialization_timeout is 300 s, so a half-configured pod
+        # (one host missing, a typo'd coordinator address) hangs five
+        # minutes before the loud RuntimeError below; ops set this low
+        # (the multihost_dryrun watcher stage uses it) to fail fast instead.
+        timeout_s = os.environ.get("JAX_COORDINATOR_TIMEOUT_S")
+        if timeout_s:
+            try:
+                kwargs["initialization_timeout"] = int(float(timeout_s))
+            except ValueError:
+                raise RuntimeError(
+                    "JAX_COORDINATOR_TIMEOUT_S must be a number of seconds, "
+                    f"got {timeout_s!r}"
+                ) from None
+    if cpu_forced:
+        # multi-process on the CPU backend (the pod dryrun / 2-process CPU
+        # e2e) needs a cross-process collectives impl, or every collective
+        # dies with "Multiprocess computations aren't implemented on the CPU
+        # backend". Must happen before the CPU client is created; keep any
+        # explicit non-default user choice (e.g. mpi).
+        try:
+            if jax.config.read("jax_cpu_collectives_implementation") == "none":
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, LookupError):
+            pass  # flag renamed/removed in a future jax; rendezvous still works
     try:
         jax.distributed.initialize(**kwargs)
         _initialized = True

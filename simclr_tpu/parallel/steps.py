@@ -303,6 +303,8 @@ def _make_local_pretrain_step(
     remat: bool,
     out_size: int,
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
 ):
     """The per-replica contrastive step, shared verbatim by the
     dispatch-per-step (:func:`make_pretrain_step`) and epoch-compiled
@@ -313,9 +315,14 @@ def _make_local_pretrain_step(
     (``parallel/compress.py``): ``exact`` is the plain fp32 psum; ``bf16``
     and ``int8`` compress the data-axis collective. Compression happens
     BEFORE ``tx.update`` — quantize-before-LARS — so every replica feeds the
-    optimizer the identical dequantized gradient.
+    optimizer the identical dequantized gradient. ``comm_overlap``/
+    ``comm_chunks`` pick the collective schedule: ``chunked`` decomposes the
+    all-reduce into independent ppermute rings XLA can overlap with the
+    backward's tail compute; ``off`` is bitwise-identical to the single-shot
+    path.
     """
     compress.validate_mode(grad_allreduce)
+    compress.validate_overlap(comm_overlap, comm_chunks)
     if negatives not in ("global", "local", "ring"):
         raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
     if forward_mode not in ("two_pass", "concat"):
@@ -356,6 +363,7 @@ def _make_local_pretrain_step(
         grads = compress.grad_allreduce(
             grads, DATA_AXIS, grad_allreduce,
             key=jax.random.fold_in(rng, compress.KEY_FOLD_QUANT),
+            overlap=comm_overlap, chunks=comm_chunks,
         )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -381,6 +389,8 @@ def make_pretrain_step(
     remat: bool = False,
     out_size: int = 32,
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
     sentry=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
     """Build the jitted contrastive train step.
@@ -401,6 +411,7 @@ def make_pretrain_step(
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
         grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     sharded = shard_map(
         local_step,
@@ -428,6 +439,8 @@ def make_pretrain_epoch_fn(
     out_size: int = 32,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled training: one XLA program per EPOCH, zero host work
@@ -466,6 +479,7 @@ def make_pretrain_epoch_fn(
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
         grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     return _watch(
         _make_epoch_fn(per_step, mesh, n_arrays=1, residency=residency),
@@ -723,6 +737,8 @@ def make_pretrain_superepoch_fn(
     out_size: int = 32,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
     monitor=None,
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
@@ -744,6 +760,7 @@ def make_pretrain_superepoch_fn(
         temperature=temperature, strength=strength, negatives=negatives,
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
         grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     idx_pos = 1 + 1 + (3 if monitor is not None else 0)
     return _watch(
@@ -757,11 +774,13 @@ def make_pretrain_superepoch_fn(
 
 
 def _make_local_supervised_step(
-    model, tx, *, strength: float, out_size: int, grad_allreduce: str = "exact"
+    model, tx, *, strength: float, out_size: int, grad_allreduce: str = "exact",
+    comm_overlap: str = "off", comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
 ):
     """Per-replica supervised CE step, shared by the dispatch-per-step and
     epoch-compiled paths (see :func:`_make_local_pretrain_step`)."""
     compress.validate_mode(grad_allreduce)
+    compress.validate_overlap(comm_overlap, comm_chunks)
 
     def local_step(state: TrainState, images, labels, rng):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
@@ -787,6 +806,7 @@ def _make_local_supervised_step(
         grads = compress.grad_allreduce(
             grads, DATA_AXIS, grad_allreduce,
             key=jax.random.fold_in(rng, compress.KEY_FOLD_QUANT),
+            overlap=comm_overlap, chunks=comm_chunks,
         )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -809,6 +829,8 @@ def make_supervised_step(
     strength: float = 0.5,
     out_size: int = 32,
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Jitted supervised CE train step (one SimCLR-augmented view).
@@ -820,6 +842,7 @@ def make_supervised_step(
     local_step = _make_local_supervised_step(
         model, tx, strength=strength, out_size=out_size,
         grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     sharded = shard_map(
         local_step,
@@ -842,6 +865,8 @@ def make_supervised_epoch_fn(
     out_size: int = 32,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled supervised training (see
@@ -855,6 +880,7 @@ def make_supervised_epoch_fn(
     per_step = _make_local_supervised_step(
         model, tx, strength=strength, out_size=out_size,
         grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     return _watch(
         _make_epoch_fn(per_step, mesh, n_arrays=2, residency=residency),

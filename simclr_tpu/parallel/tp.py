@@ -131,6 +131,8 @@ def _make_step_body(
     out_size: int,
     remat: bool = False,
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
 ):
     """The un-jitted TP step: shard_map'ed forward/backward + jit-level
     optimizer update. Shared by the dispatch-per-step and epoch-compiled
@@ -142,8 +144,13 @@ def _make_step_body(
     (``parallel/compress.py``); the head's model-axis f/g collectives stay
     exact. The quantization key is forked from the data-index-folded rng, so
     model-axis replicas draw identical rounding noise and replicated
-    (encoder) gradients stay identical across the model axis."""
+    (encoder) gradients stay identical across the model axis.
+    ``comm_overlap``/``comm_chunks`` likewise apply to the data-axis ring
+    only — each ppermute ring runs within a model-axis replica's data ring,
+    and the gather phase forwards bytes verbatim, so model-axis replicas
+    still dequantize identical gradients."""
     compress.validate_mode(grad_allreduce)
+    compress.validate_overlap(comm_overlap, comm_chunks)
     tp = mesh.shape[MODEL_AXIS]
     local_model = _local_view(model, tp)
     fwd = _forward_fn(local_model, remat)  # the dp step's forward/remat recipe
@@ -166,6 +173,7 @@ def _make_step_body(
         grads = compress.grad_allreduce(
             grads, DATA_AXIS, grad_allreduce,
             key=jax.random.fold_in(rng, compress.KEY_FOLD_QUANT),
+            overlap=comm_overlap, chunks=comm_chunks,
         )
         # No model-axis correction here: the head's f/g boundary operators
         # (models/heads.py) own the model-axis collectives in both forward
@@ -209,6 +217,8 @@ def make_pretrain_step_tp(
     out_size: int = 32,
     remat: bool = False,
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Contrastive train step with the projection head tensor-parallel over
     the ``model`` mesh axis (global NT-Xent negatives over ``data``).
@@ -222,6 +232,7 @@ def make_pretrain_step_tp(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
         remat=remat, grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     return jax.jit(step, donate_argnums=(0,))
 
@@ -237,6 +248,8 @@ def make_pretrain_epoch_fn_tp(
     remat: bool = False,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
 ) -> Callable[..., tuple[TrainState, dict]]:
     """Epoch-compiled TP training: ``lax.scan`` over steps at the JIT level.
 
@@ -265,6 +278,7 @@ def make_pretrain_epoch_fn_tp(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
         remat=remat, grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     batched = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -312,6 +326,8 @@ def make_pretrain_superepoch_fn_tp(
     remat: bool = False,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
+    comm_overlap: str = "off",
+    comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
     monitor=None,
 ) -> Callable[..., tuple[TrainState, dict]]:
     """Superepoch-compiled TP training: an outer ``lax.scan`` over K epochs
@@ -337,6 +353,7 @@ def make_pretrain_superepoch_fn_tp(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
         remat=remat, grad_allreduce=grad_allreduce,
+        comm_overlap=comm_overlap, comm_chunks=comm_chunks,
     )
     batched = NamedSharding(mesh, P(DATA_AXIS))
     array_spec = P() if residency == "replicated" else P(DATA_AXIS)

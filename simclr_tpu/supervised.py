@@ -40,6 +40,7 @@ from simclr_tpu.obs.events import EventLog
 from simclr_tpu.obs.exporter import maybe_start_exporter
 from simclr_tpu.obs.telemetry import Telemetry
 from simclr_tpu.ops.lars import get_weight_decay_mask, lars
+from simclr_tpu.parallel.compress import DEFAULT_COMM_CHUNKS, normalize_overlap
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -49,6 +50,7 @@ from simclr_tpu.parallel.mesh import (
     put_global_batch,
     put_replicated,
     put_row_sharded,
+    put_tree,
     replicated_sharding,
     validate_per_device_batch,
 )
@@ -161,7 +163,7 @@ def run_supervised(cfg: Config) -> dict:
     state = create_train_state(
         model, tx, jax.random.key(seed), jnp.zeros((2, 32, 32, 3), jnp.float32)
     )
-    state = jax.device_put(state, replicated_sharding(mesh))
+    state = put_tree(state, replicated_sharding(mesh))
 
     save_dir = resolve_save_dir(cfg)
     # run telemetry + event timeline (simclr_tpu/obs/, docs/OBSERVABILITY.md),
@@ -221,6 +223,12 @@ def run_supervised(cfg: Config) -> dict:
             model, tx, mesh, strength=float(cfg.experiment.strength),
             residency=residency,
             grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+            comm_overlap=str(
+                normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
+            ),
+            comm_chunks=int(
+                cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
+            ),
             sentry=sentry,
         )
         put_dataset = put_replicated if residency == "replicated" else put_row_sharded
@@ -231,6 +239,12 @@ def run_supervised(cfg: Config) -> dict:
         train_step = make_supervised_step(
             model, tx, mesh, strength=float(cfg.experiment.strength),
             grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
+            comm_overlap=str(
+                normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
+            ),
+            comm_chunks=int(
+                cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
+            ),
             sentry=sentry,
         )
         train_iter = EpochIterator(

@@ -62,6 +62,35 @@ def test_reports_all_modes_with_wire_bytes_and_timings():
     assert abs(payload["value"] - want) < 0.01
 
 
+def test_overlap_flag_adds_per_chunk_columns():
+    """--overlap (here via env, as tpu_watch passes the flag) adds an
+    "overlap" table per mode: ms/step plus the ring's analytic wire bytes
+    at every requested chunk count — the payload shape the watcher stage's
+    done-marker greps for."""
+    r = _run({
+        "ALLREDUCE_BENCH_SIZES": "tiny=8192",
+        "ALLREDUCE_BENCH_ITERS": "1",
+        "ALLREDUCE_BENCH_MODES": "exact,int8",
+        "ALLREDUCE_BENCH_OVERLAP": "1",
+        "ALLREDUCE_BENCH_CHUNKS": "2,3",
+    }, timeout=480)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _payload_lines(r.stdout)
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert payload["overlap_chunks"] == [2, 3]
+    from simclr_tpu.parallel.compress import allreduce_wire_bytes
+
+    for mode, entry in payload["models"]["tiny"]["modes"].items():
+        assert set(entry["overlap"]) == {"2", "3"}, mode
+        for c, row in entry["overlap"].items():
+            assert row["ms_per_step"] > 0.0, (mode, c)
+            want_mb = allreduce_wire_bytes(
+                8192, 8, mode, overlap="chunked", chunks=int(c)
+            ) / 2**20
+            assert abs(row["wire_mb_per_device"] - want_mb) < 1e-3, (mode, c)
+
+
 def test_exhausted_budget_skips_loudly_and_still_emits():
     r = _run({
         "ALLREDUCE_BENCH_SIZES": "tiny=4096",

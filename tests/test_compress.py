@@ -44,7 +44,8 @@ from tests.helpers import TinyContrastive, TinySupervised, random_images
 N_DEV = 8
 
 
-def _allreduce_on_mesh(tree, mode, *, bucket_size=DEFAULT_BUCKET_SIZE, seed=0):
+def _allreduce_on_mesh(tree, mode, *, bucket_size=DEFAULT_BUCKET_SIZE, seed=0,
+                       overlap="off", chunks=1):
     """Run ``grad_allreduce`` under shard_map: device i contributes
     ``tree + i * 0.01`` per leaf; returns (per-device stacked result, the
     exact psum). Keys are folded per data shard, as the train steps do."""
@@ -55,7 +56,10 @@ def _allreduce_on_mesh(tree, mode, *, bucket_size=DEFAULT_BUCKET_SIZE, seed=0):
         i = jax.lax.axis_index(DATA_AXIS)
         local = jax.tree.map(lambda l: l + 0.01 * i.astype(l.dtype), tree)
         key = jax.random.fold_in(jax.random.key(seed), i)
-        out = grad_allreduce(local, DATA_AXIS, mode, key=key, bucket_size=bucket_size)
+        out = grad_allreduce(
+            local, DATA_AXIS, mode, key=key, bucket_size=bucket_size,
+            overlap=overlap, chunks=chunks,
+        )
         exact = jax.lax.psum(local, DATA_AXIS)
         return jax.tree.map(lambda x: x[None], (out, exact))
 
@@ -213,6 +217,150 @@ class TestAllreduceEquivalence:
 
 
 # ---------------------------------------------------------------------------
+# Chunked ring (comm_overlap=chunked): parity vs single-shot, invariants
+# ---------------------------------------------------------------------------
+
+_CHUNKED_CACHE: dict = {}
+
+
+def _chunked_on_mesh(mode, chunks, seed=0):
+    """Memoized chunked-ring run: an unrolled int8 ring costs ~35 s of XLA
+    compile on the CPU mesh, so the invariant tests share one execution."""
+    k = (mode, chunks, seed)
+    if k not in _CHUNKED_CACHE:
+        _CHUNKED_CACHE[k] = _allreduce_on_mesh(
+            TestAllreduceEquivalence.TREE, mode, bucket_size=32, seed=seed,
+            overlap="chunked", chunks=chunks,
+        )
+    return _CHUNKED_CACHE[k]
+
+
+class TestChunkedRing:
+    TREE = TestAllreduceEquivalence.TREE
+
+    # int8 rings requantize the running partial at every reduce-scatter hop
+    # (n-1 extra roundings vs single-shot), so the bound is hop-scaled; bf16
+    # accumulates pairwise in bf16 over n-1 hops
+    RING_TOL = {"exact": 1e-5, "bf16": 2.0 ** -4, "int8": None}
+
+    # chunks=3 does not divide the 97/256/354-element layout: every mode
+    # crosses a ragged tail chunk; chunks=1 pins the single-ring degenerate
+    @pytest.mark.parametrize("mode,chunks", [
+        ("exact", 1), ("exact", 3), ("bf16", 3), ("int8", 3),
+        pytest.param("bf16", 8, marks=pytest.mark.slow),
+        pytest.param("int8", 8, marks=pytest.mark.slow),
+    ])
+    def test_chunked_matches_psum_within_mode_tolerance(self, mode, chunks):
+        got, exact = _chunked_on_mesh(mode, chunks)
+        if mode == "int8":
+            flat_exact = np.concatenate(
+                [np.asarray(l[0]).ravel() for l in jax.tree.leaves(exact)]
+            )
+            local_amax = max(
+                float(np.max(np.abs(np.asarray(l)), initial=0.0))
+                for l in self.TREE.values()
+            ) + 0.01 * (N_DEV - 1)
+            # each of n-1 hops rounds the running partial (amax <= n*local)
+            # by one quantum, plus the gather-phase requantization
+            bound = 1.1 * N_DEV * (
+                N_DEV * local_amax + float(np.max(np.abs(flat_exact)))
+            ) / 127.0
+            err = jax.tree.map(
+                lambda g, e: np.max(np.abs(g - e), initial=0.0), got, exact
+            )
+            assert max(jax.tree.leaves(err)) <= bound
+        else:
+            tol = self.RING_TOL[mode]
+            jax.tree.map(
+                lambda g, e: np.testing.assert_allclose(g, e, rtol=tol, atol=tol),
+                got, exact,
+            )
+
+    @pytest.mark.parametrize("mode", ["bf16", "int8"])
+    def test_chunked_replicas_bitwise_identical(self, mode):
+        """The gather phase forwards each owner's wire bytes VERBATIM, so
+        every replica dequantizes identical payloads — the invariant the
+        jit-level LARS update relies on survives chunking."""
+        got, _ = _chunked_on_mesh(mode, 3)
+        for name, leaf in got.items():
+            leaf = np.asarray(leaf)
+            for j in range(1, N_DEV):
+                np.testing.assert_array_equal(leaf[0], leaf[j], err_msg=name)
+
+    def test_off_bitwise_identical_to_default_call(self):
+        """overlap="off" IS the pre-knob single-shot path: bitwise-equal
+        output to a call that never mentions overlap, for the stochastic
+        mode where any code motion would show."""
+        a, _ = _allreduce_on_mesh(self.TREE, "int8", bucket_size=32, seed=4)
+        b, _ = _allreduce_on_mesh(
+            self.TREE, "int8", bucket_size=32, seed=4, overlap="off", chunks=7
+        )
+        jax.tree.map(np.testing.assert_array_equal, a, b)
+
+    def test_chunks_exceeding_elements(self):
+        """More chunks than elements degrades to one ring per element —
+        never an empty chunk, result still the psum."""
+        tree = {"w": np.linspace(-1, 1, 5, dtype=np.float32)}
+        got, exact = _allreduce_on_mesh(
+            tree, "exact", overlap="chunked", chunks=64
+        )
+        np.testing.assert_allclose(got["w"], exact["w"], rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.slow
+    def test_chunked_reproducible_and_chunk_count_sensitive(self):
+        """Per-chunk keys: same (seed, chunks) reproduces bitwise; a
+        different chunk count re-keys the quantizer and must not reproduce
+        (a silent key-reuse bug would)."""
+        a, _ = _allreduce_on_mesh(
+            self.TREE, "int8", bucket_size=32, seed=5, overlap="chunked", chunks=3
+        )
+        b, _ = _allreduce_on_mesh(
+            self.TREE, "int8", bucket_size=32, seed=5, overlap="chunked", chunks=3
+        )
+        c, _ = _allreduce_on_mesh(
+            self.TREE, "int8", bucket_size=32, seed=5, overlap="chunked", chunks=2
+        )
+        jax.tree.map(np.testing.assert_array_equal, a, b)
+        assert any(
+            not np.array_equal(x, y)
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(c))
+        )
+
+    def test_overlap_validation(self):
+        with pytest.raises(ValueError, match="off.*chunked"):
+            compress.validate_overlap("ring")
+        for bad in (0, -1, compress.MAX_COMM_CHUNKS + 1, 2.5):
+            with pytest.raises(ValueError, match=r"\[1, 64\]"):
+                compress.validate_overlap("chunked", bad)
+        compress.validate_overlap("chunked", compress.MAX_COMM_CHUNKS)
+        with pytest.raises(ValueError, match="comm_overlap"):
+            grad_allreduce(
+                {"w": jnp.ones(3)}, DATA_AXIS, "exact", overlap="ring"
+            )
+
+    def test_normalize_overlap_yaml_false(self):
+        # YAML 1.1 parses bare `off` as boolean False; the config boundary
+        # must land on the string before validation
+        assert compress.normalize_overlap(False) == "off"
+        assert compress.normalize_overlap("chunked") == "chunked"
+
+    def test_chunked_wire_bytes(self):
+        n = 8 * 1024
+        # exact fp32: chunking contiguous fp32 segments adds no padding
+        # when every chunk stays a multiple of the axis size
+        assert allreduce_wire_bytes(
+            n, 8, "exact", overlap="chunked", chunks=4
+        ) == pytest.approx(allreduce_wire_bytes(n, 8, "exact"))
+        # int8: per-chunk bucket padding can only add bytes, and stays
+        # small relative to the payload at real sizes
+        off = allreduce_wire_bytes(2**20, 8, "int8")
+        on = allreduce_wire_bytes(2**20, 8, "int8", overlap="chunked", chunks=8)
+        assert off <= on <= 1.1 * off
+        with pytest.raises(ValueError, match="comm_chunks"):
+            allreduce_wire_bytes(n, 8, "exact", overlap="chunked", chunks=0)
+
+
+# ---------------------------------------------------------------------------
 # Train-path equivalence: dp per-step, epoch_compile, supervised
 # ---------------------------------------------------------------------------
 
@@ -220,7 +368,7 @@ def _tx():
     return lars(0.1, weight_decay=1e-4, weight_decay_mask=simclr_weight_decay_mask)
 
 
-def _pretrain_losses(mode, n_steps=2, batch=16):
+def _pretrain_losses(mode, n_steps=2, batch=16, **step_kwargs):
     mesh = create_mesh()
     model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
     tx = _tx()
@@ -229,7 +377,7 @@ def _pretrain_losses(mode, n_steps=2, batch=16):
     )
     step = make_pretrain_step(
         model, tx, mesh, temperature=0.5, strength=0.5, negatives="global",
-        grad_allreduce=mode,
+        grad_allreduce=mode, **step_kwargs,
     )
     sharding = batch_sharding(mesh)
     losses = []
@@ -240,7 +388,7 @@ def _pretrain_losses(mode, n_steps=2, batch=16):
     return losses
 
 
-def _epoch_losses(mode, steps=2, batch=16):
+def _epoch_losses(mode, steps=2, batch=16, **step_kwargs):
     mesh = create_mesh()
     model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
     tx = _tx()
@@ -249,7 +397,7 @@ def _epoch_losses(mode, steps=2, batch=16):
     )
     epoch_fn = make_pretrain_epoch_fn(
         model, tx, mesh, temperature=0.5, strength=0.5, negatives="global",
-        grad_allreduce=mode,
+        grad_allreduce=mode, **step_kwargs,
     )
     images_all = jnp.asarray(random_images(steps * batch, seed=0))
     idx = jnp.arange(steps * batch, dtype=jnp.int32).reshape(steps, batch)
@@ -308,7 +456,7 @@ class TestTrainPathEquivalence:
 # dp x tp: compress over data only; model replicas must stay in lockstep
 # ---------------------------------------------------------------------------
 
-def _tp_losses(mode, n_steps=2, per_device_batch=2):
+def _tp_losses(mode, n_steps=2, per_device_batch=2, **step_kwargs):
     from simclr_tpu.models.contrastive import ContrastiveModel
     from simclr_tpu.parallel.tp import make_pretrain_step_tp, tp_state_shardings
     from simclr_tpu.utils.schedule import warmup_cosine_schedule
@@ -328,7 +476,8 @@ def _tp_losses(mode, n_steps=2, per_device_batch=2):
     )
     state = jax.device_put(state, tp_state_shardings(mesh, state))
     step = make_pretrain_step_tp(
-        model, tx, mesh, temperature=0.5, strength=0.5, grad_allreduce=mode
+        model, tx, mesh, temperature=0.5, strength=0.5, grad_allreduce=mode,
+        **step_kwargs,
     )
     batch = jax.device_put(
         random_images(per_device_batch * 4, seed=0), batch_sharding(mesh)
@@ -338,6 +487,73 @@ def _tp_losses(mode, n_steps=2, per_device_batch=2):
         state, metrics = step(state, batch, jax.random.key(100 + i))
         losses.append(float(metrics["loss"]))
     return losses, jax.device_get(state.params)
+
+
+# ---------------------------------------------------------------------------
+# Train-path: comm_overlap=chunked within dryrun parity tolerance of off
+# ---------------------------------------------------------------------------
+
+# chunked exact is the same fp32 sum in a different association order —
+# loss-level drift is roundoff only; quantized modes inherit the step TOL
+CHUNK_TOL = {"exact": 1e-4, "bf16": 2e-2, "int8": 5e-2}
+
+
+class TestTrainPathChunked:
+    @pytest.mark.parametrize("mode", ["exact", "int8"])
+    def test_dp_per_step(self, mode):
+        off = _pretrain_losses(mode)
+        got = _pretrain_losses(mode, comm_overlap="chunked", comm_chunks=3)
+        assert all(np.isfinite(got))
+        np.testing.assert_allclose(got, off, atol=CHUNK_TOL[mode])
+
+    def test_epoch_compile(self):
+        off = _epoch_losses("int8")
+        got = _epoch_losses("int8", comm_overlap="chunked", comm_chunks=3)
+        assert all(np.isfinite(got))
+        np.testing.assert_allclose(got, off, atol=CHUNK_TOL["int8"])
+
+    # sharded is the multihost-relevant residency (put_row_sharded feeds
+    # only local rows); the replicated variant rides in the slow tier
+    @pytest.mark.parametrize("residency", [
+        "sharded", pytest.param("replicated", marks=pytest.mark.slow),
+    ])
+    def test_superepoch(self, residency):
+        """A chunked K=2 superepoch tracks the off superepoch for both
+        residency paths (the compiled-dataset program embeds the ring)."""
+        from simclr_tpu.data.pipeline import epoch_index_matrix
+        from simclr_tpu.parallel.mesh import put_replicated, put_row_sharded
+        from simclr_tpu.parallel.steps import make_pretrain_superepoch_fn
+
+        k, steps, batch = 2, 2, 16
+        dataset = steps * batch
+        mesh = create_mesh()
+        model = TinyContrastive(bn_cross_replica_axis=DATA_AXIS)
+        images = random_images(dataset, seed=3)
+        put = put_replicated if residency == "replicated" else put_row_sharded
+        idx = jnp.asarray(
+            np.stack([
+                epoch_index_matrix(dataset, 0, e, steps, batch)
+                for e in range(1, 1 + k)
+            ])
+        )
+
+        def run(**kw):
+            tx = _tx()
+            state = create_train_state(
+                model, tx, jax.random.key(0),
+                jnp.zeros((batch, 32, 32, 3), jnp.float32),
+            )
+            fn = make_pretrain_superepoch_fn(
+                model, tx, mesh, temperature=0.5, strength=0.5,
+                residency=residency, grad_allreduce="int8", **kw,
+            )
+            _, hist = fn(state, put(images, mesh), idx, jax.random.key(9), 0)
+            return np.asarray(hist["loss"]).ravel()
+
+        off = run()
+        got = run(comm_overlap="chunked", comm_chunks=3)
+        assert np.all(np.isfinite(got))
+        np.testing.assert_allclose(got, off, atol=CHUNK_TOL["int8"])
 
 
 @pytest.mark.slow
@@ -350,6 +566,19 @@ def test_tp_data_axis_compression_matches_exact(mode):
     # replicated (encoder) leaves must remain consistent: the jit-level LARS
     # update only preserves replication if dequantized grads are replica-
     # identical across the model axis (keys fold the DATA index only)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        assert np.all(np.isfinite(np.asarray(leaf))), jax.tree_util.keystr(path)
+
+
+@pytest.mark.slow
+def test_tp_chunked_ring_matches_off():
+    """dp x tp with the chunked ring on the data axis: model-axis replicas
+    must still receive identical dequantized gradients (the ring's
+    verbatim-forwarding gather preserves the lockstep invariant)."""
+    off, _ = _tp_losses("int8")
+    got, params = _tp_losses("int8", comm_overlap="chunked", comm_chunks=3)
+    assert all(np.isfinite(got))
+    np.testing.assert_allclose(got, off, atol=CHUNK_TOL["int8"])
     for path, leaf in jax.tree_util.tree_leaves_with_path(params):
         assert np.all(np.isfinite(np.asarray(leaf))), jax.tree_util.keystr(path)
 

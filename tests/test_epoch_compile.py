@@ -23,6 +23,7 @@ from simclr_tpu.parallel.mesh import (
     batch_sharding,
     create_mesh,
     put_row_sharded,
+    put_tree,
     replicated_sharding,
     shard_map,
 )
@@ -250,6 +251,79 @@ def test_sharded_rows_gather_exact():
     sharded = put_row_sharded(rows, mesh)
     got = _gather_fn(mesh)(sharded, jnp.asarray(idx))
     np.testing.assert_array_equal(np.asarray(got), rows[idx])
+
+
+def test_put_row_sharded_upload_feeds_only_addressable_rows(monkeypatch):
+    """Multi-host residency preflight: the upload callback must be invoked
+    once per ADDRESSABLE shard with exactly that shard's contiguous row
+    block — never the full array per device. On a pod this is what keeps
+    the epoch_compile upload O(N / n_processes) per host; the 2-process
+    half of the claim is asserted end to end by scripts/multihost_dryrun.py
+    (each process reports local_rows == its addressable row count)."""
+    mesh = create_mesh()
+    n_data = mesh.shape[DATA_AXIS]
+    rows = np.arange(64 * 3, dtype=np.float32).reshape(64, 3)
+    requested = []
+    orig = jax.make_array_from_callback
+
+    def spy(shape, sharding, cb):
+        def wrapped(idx):
+            requested.append(idx)
+            return cb(idx)
+        return orig(shape, sharding, wrapped)
+
+    monkeypatch.setattr(jax, "make_array_from_callback", spy)
+    sharded = put_row_sharded(rows, mesh)
+    np.testing.assert_array_equal(np.asarray(sharded), rows)
+
+    per_shard = 64 // n_data
+    # one callback per addressable shard (jax may coalesce duplicates, so
+    # compare as sets of row ranges), each asking for one shard-sized block
+    got_blocks = {
+        (idx[0].start or 0, idx[0].stop if idx[0].stop is not None else 64)
+        for idx in requested
+    }
+    want_blocks = {
+        (s.index[0].start or 0, s.index[0].stop)
+        for s in sharded.addressable_shards
+    }
+    assert got_blocks == want_blocks
+    for start, stop in got_blocks:
+        assert stop - start == per_shard
+    # and the addressable blocks tile this process's rows exactly once
+    covered = sorted(got_blocks)
+    assert covered[0][0] == 0 and covered[-1][1] == 64
+    assert all(a[1] == b[0] for a, b in zip(covered, covered[1:]))
+
+
+def test_put_tree_single_process_matches_device_put():
+    """put_tree is the state-placement path (main.py/supervised.py): in a
+    single process it must be exactly device_put — same values, same
+    shardings — whether given one sharding for every leaf or a matching
+    pytree of per-leaf shardings (the tensor-parallel layout case)."""
+    mesh = create_mesh()
+    tree = {
+        "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.float32(2.5),
+        "n": np.int32(7),
+    }
+    placed = put_tree(tree, replicated_sharding(mesh))
+    want = jax.device_put(tree, replicated_sharding(mesh))
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(placed[k]), np.asarray(want[k]))
+        assert placed[k].sharding == want[k].sharding, k
+
+    shardings = {
+        "w": batch_sharding(mesh),  # rows over the data axis
+        "b": replicated_sharding(mesh),
+        "n": replicated_sharding(mesh),
+    }
+    # 3 rows don't divide the 8-way axis; use a divisible leaf instead
+    tree["w"] = np.arange(64, dtype=np.float32).reshape(8, 8)
+    placed = put_tree(tree, shardings)
+    np.testing.assert_array_equal(np.asarray(placed["w"]), tree["w"])
+    assert placed["w"].sharding == batch_sharding(mesh)
+    assert placed["b"].sharding == replicated_sharding(mesh)
 
 
 def test_sharded_rows_gather_padded_tail():

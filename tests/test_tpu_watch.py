@@ -20,9 +20,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
-    "allreduce_bench", "remat2048", "explore1024", "explore512",
-    "supervisor_smoke", "obs_smoke", "compile_audit", "superepoch",
-    "run_report",
+    "allreduce_bench", "multihost_dryrun", "remat2048", "explore1024",
+    "explore512", "supervisor_smoke", "obs_smoke", "compile_audit",
+    "superepoch", "run_report",
 )
 
 
@@ -65,12 +65,20 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         lines += [f'case "$*" in *{name}*) exit 1;; esac']
     lines += [
         # the allreduce_bench stage greps its stdout for an error-free
-        # payload line (its script exits 0 even on error); note the
-        # *bench.py* case below also substring-matches this invocation,
-        # harmlessly re-touching the capture
+        # payload line that carries the chunked-ring overlap table (the
+        # stage passes --overlap; its script exits 0 even on error); note
+        # the *bench.py* case below also substring-matches this
+        # invocation, harmlessly re-touching the capture
         'case "$*" in *allreduce_bench.py*) '
         'echo \'{"metric": "allreduce_wire_reduction_int8_vs_exact", '
-        '"value": 3.98, "unit": "x"}\';; esac',
+        '"value": 3.98, "unit": "x", "overlap_chunks": [2, 4, 8], '
+        '"models": {"resnet18": {"modes": {"int8": {"ms_per_step": 1.5, '
+        '"overlap": {"4": {"ms_per_step": 1.2}}}}}}}\';; esac',
+        # the multihost_dryrun stage greps its stdout for a 2-process
+        # parity payload (its orchestrator also exits 0 on error)
+        'case "$*" in *multihost_dryrun.py*) '
+        'echo \'{"metric": "multihost_dryrun_parity", "value": 1.0, '
+        '"unit": "bool", "process_count": 2, "parity": true}\';; esac',
         # the supervisor_smoke stage greps its stdout for a clean outcome
         # with at least one resume (an uncrashed run also exits 0)
         'case "$*" in *simclr_tpu.supervisor*) '
@@ -189,11 +197,54 @@ def test_allreduce_marker_requires_error_free_payload(tmp_path):
     _write_stub(tmp_path)
     stub = tmp_path / "bin" / "python"
     stub.write_text(stub.read_text().replace(
-        '"value": 3.98, "unit": "x"}', '"value": 0.0, "error": "boom"}'))
+        '"value": 3.98, "unit": "x"', '"value": 0.0, "error": "boom"'))
     r, state, log = _run_oneshot(tmp_path)
     assert "allreduce_bench" not in _done(state)
     assert (state / "allreduce_bench.fails").exists()
     assert "stage allreduce_bench FAILED" in log.read_text()
+
+
+def test_allreduce_marker_requires_overlap_table(tmp_path):
+    """The stage passes --overlap, so a payload WITHOUT the chunked-ring
+    overlap columns (budget exhausted before any chunked pair ran, or an
+    old-format script) is incomplete evidence and must not earn
+    allreduce_bench.done — the stage retries next window."""
+    calls = _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text()
+                    .replace(', "overlap_chunks": [2, 4, 8]', "")
+                    .replace(', "overlap": {"4": {"ms_per_step": 1.2}}', ""))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "allreduce_bench" not in _done(state)
+    assert (state / "allreduce_bench.fails").exists()
+    assert "stage allreduce_bench FAILED" in log.read_text()
+    # and the stage really asked for the overlap columns
+    assert "allreduce_bench.py --overlap" in calls.read_text()
+
+
+def test_multihost_marker_requires_two_process_parity(tmp_path):
+    """The multihost_dryrun orchestrator exits 0 even on failure, so the
+    done marker must demand the full claim: 2 real processes AND bitwise
+    parity. A single-process fallback payload proves nothing about the
+    pod path."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"process_count": 2, "parity": true',
+        '"process_count": 1, "parity": true'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "multihost_dryrun" not in _done(state)
+    assert (state / "multihost_dryrun.fails").exists()
+    assert "stage multihost_dryrun FAILED" in log.read_text()
+
+    # second contract: 2 processes but the checksums diverged
+    stub.write_text(stub.read_text().replace(
+        '"process_count": 1, "parity": true',
+        '"process_count": 2, "parity": false, "error": "diverged"'))
+    (state / "multihost_dryrun.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "multihost_dryrun" not in _done(state)
+    assert (state / "multihost_dryrun.fails").exists()
 
 
 def test_supervisor_marker_requires_an_actual_resume(tmp_path):

@@ -61,7 +61,14 @@ VARIANTS = {
     # epoch (= 1/K) alongside throughput — the Podracer trade, docs/PERF.md
     # "Host round-trip budget"
     "superepoch": dict(forward_mode="two_pass"),
+    # augmentation impl sweep (runtime.augment_impl): the vmapped XLA chain
+    # vs the fused Pallas one-VMEM-pass kernel inside the full train step —
+    # the in-context number next to scripts/augment_bench.py's isolated one
+    # (docs/PERF.md "Fused augmentation")
+    "augment": dict(forward_mode="two_pass"),
 }
+
+AUGMENT_IMPLS = ("xla", "fused")
 
 SUPEREPOCH_KS = (1, 2, 5, 10)
 
@@ -149,6 +156,26 @@ def main() -> None:
                     "compile_s": round(max(t_warm - dt, 0.0), 2),
                     # the whole point: boundary fetches per trained epoch
                     "host_syncs_per_epoch": round(1.0 / k, 3),
+                    "final_loss": round(loss, 4),
+                }), flush=True)
+            continue
+        if name == "augment":
+            for impl in AUGMENT_IMPLS:
+                step = make_pretrain_step(
+                    model, tx, mesh, temperature=0.5, strength=0.5,
+                    negatives="global", augment_impl=impl, **kw,
+                )
+                state = build_state(model, tx, mesh)
+                dt, loss = time_stepwise(
+                    step, state, batches, rng, args.warmup, args.steps
+                )
+                print(json.dumps({
+                    "variant": f"augment_{impl}",
+                    "augment_impl": impl,
+                    "imgs_per_sec_per_chip": round(
+                        args.steps * global_batch / dt / mesh.size, 1
+                    ),
+                    "ms_per_step": round(dt / args.steps * 1e3, 2),
                     "final_loss": round(loss, 4),
                 }), flush=True)
             continue

@@ -87,7 +87,42 @@ def conv_ops(n, h, w, cin, cout, k, stride=1, input_grad=True):
     return fwd, bwd
 
 
-def model_step(arch: str, per_device_batch: int, d: int = 128):
+def augment_bytes(
+    per_device_batch: int,
+    impl: str = "xla",
+    *,
+    out_size: int = 32,
+    height: int = 32,
+    width: int = 32,
+    channels: int = 3,
+) -> int:
+    """Analytic HBM bytes of the two-view augmentation per device-step.
+
+    xla:   the vmapped per-view chain makes ~3 full passes over the batch
+           (dequant+crop, jitter, grayscale/select), each reading uint8 or
+           f32 and writing f32 intermediates — the measured ~2.2 ms row.
+    fused: the Pallas kernel (simclr_tpu/ops/augment_pallas.py) reads each
+           resident uint8 tile into VMEM ONCE and writes the two float32
+           views — no per-stage HBM intermediates, so traffic collapses to
+           the information-theoretic floor: one uint8 batch in, two f32
+           views out (plus a negligible (n, 15) f32 parameter row stream,
+           counted for honesty).
+
+    Shared with scripts/augment_bench.py so the bench's "analytic HBM
+    bytes" column and the live-MFU roofline can never disagree.
+    """
+    n = 2 * per_device_batch  # two views
+    if impl == "fused":
+        in_b = per_device_batch * height * width * channels  # uint8 read once
+        out_b = n * out_size * out_size * channels * F32  # two f32 views
+        params_b = n * 15 * F32  # per-view sampler rows streamed to VMEM
+        return in_b + out_b + params_b
+    return 3 * (n * height * width * channels * (1 + F32))
+
+
+def model_step(
+    arch: str, per_device_batch: int, d: int = 128, augment_impl: str = "xla"
+):
     """Yield (name, flops, bytes) for every op of the full train step."""
     n = 2 * per_device_batch  # two views through the shared encoder
     ops = []
@@ -140,10 +175,14 @@ def model_step(arch: str, per_device_batch: int, d: int = 128):
     sim_by = n * d * BF16 + g * d * BF16 + n * g * F32
     add("ntxent sim+softmax", (sim_fl, 3 * sim_by, mxu_eff(g, d)),
         (2 * sim_fl, 3 * sim_by, mxu_eff(d, g)))
-    # augmentation: matmul-form RRC + jitter, measured ~2.2 ms r1; traffic
-    # ~= 3 uint8/ f32 passes over the raw batch. VPU work: eff n/a (1.0)
-    aug_by = 3 * (n * 32 * 32 * 3 * (1 + F32))
-    ops.append(("augment (2 views)", n * 32 * 32 * 3 * 40, aug_by, 1.0))
+    # augmentation: matmul-form RRC + jitter, measured ~2.2 ms r1 on the
+    # xla path (~3 uint8/f32 passes over the raw batch); the fused Pallas
+    # kernel collapses traffic to one uint8 read + two f32 view writes.
+    # FLOPs are identical — both impls run the same crop/jitter math; only
+    # the HBM bytes change. VPU work: eff n/a (1.0)
+    aug_by = augment_bytes(per_device_batch, augment_impl)
+    aug_name = f"augment (2 views, {augment_impl})"
+    ops.append((aug_name, n * 32 * 32 * 3 * 40, aug_by, 1.0))
     # LARS + momentum: elementwise over ~11.5M params: read p,m,g (f32),
     # write p,m; plus the per-layer norm reductions (reads again)
     params = 11_498_048
@@ -157,9 +196,14 @@ def main():
     ap.add_argument("--batch", type=int, default=512)
     ap.add_argument("--arch", default="resnet18")
     ap.add_argument("--per-layer", action="store_true")
+    ap.add_argument(
+        "--augment-impl", default="xla", choices=("xla", "fused"),
+        help="augmentation pipeline the step runs (runtime.augment_impl): "
+             "fused attributes the Pallas kernel's reclaimed HBM bandwidth",
+    )
     args = ap.parse_args()
 
-    ops = model_step(args.arch, args.batch)
+    ops = model_step(args.arch, args.batch, augment_impl=args.augment_impl)
     tot_fl = sum(o[1] for o in ops)
     tot_by = sum(o[2] for o in ops)
     naive_s = 0.0  # peak-MXU roofline (ignores tiling)
@@ -181,7 +225,12 @@ def main():
                   f"{tms:9.4f} {kind}")
     crit_ai = PEAK_TFLOPS / PEAK_HBM
     print(f"\narch={args.arch} per-device batch={args.batch} "
-          f"(2 views = {2*args.batch} images/step)")
+          f"(2 views = {2*args.batch} images/step) "
+          f"augment_impl={args.augment_impl}")
+    if args.augment_impl == "fused":
+        saved = augment_bytes(args.batch, "xla") - augment_bytes(args.batch, "fused")
+        print(f"fused augmentation reclaims {saved/1e6:.2f} MB/step of HBM "
+              f"traffic ({saved/PEAK_HBM*1e6:.1f} us at peak BW) vs xla")
     print(f"total: {tot_fl/1e12:.3f} TFLOP, {tot_by/1e9:.2f} GB "
           f"(program AI {tot_fl/tot_by:.0f} FLOP/B; critical AI "
           f"{crit_ai:.0f})")

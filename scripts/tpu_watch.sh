@@ -54,7 +54,7 @@ MAX_STAGE_FAILS=3
 # chip lock — proves the pod code path on the host), then the remaining
 # step matrices, and last the supervisor kill/resume smoke (fault
 # tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench multihost_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench augment_bench multihost_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -199,6 +199,30 @@ run_stage() {
             if [ "$rc" -eq 0 ]; then
                 grep -q '"metric": "allreduce_wire_reduction' "$out" \
                     && grep -q '"overlap"' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        augment_bench)
+            # two-view augmentation microbench (xla chain vs the fused
+            # Pallas kernel, scripts/augment_bench.py): ms/batch + analytic
+            # HBM bytes per impl at the flagship batch sizes — the numbers
+            # PERF.md's "Fused augmentation" pending-hardware row waits on.
+            # The script exits 0 even on error (bench.py robustness
+            # contract), so rc alone proves nothing: the done marker
+            # requires an error-free payload WITH the per-impl table (both
+            # "xla" and "fused" entries present) AND zero post-warmup
+            # recompile alarms — a kernel that recompiles mid-bench has an
+            # unstable signature and would alarm CompileSentry in training.
+            out="$STATE/augment_bench.out"
+            run_locked "$(stage_timeout 900)" python scripts/augment_bench.py \
+                > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"metric": "augment_hbm_reduction' "$out" \
+                    && grep -q '"xla"' "$out" \
+                    && grep -q '"fused"' "$out" \
+                    && grep -q '"recompile_alarms": 0' "$out" \
                     && ! grep -q '"error"' "$out"
                 rc=$?
             fi ;;

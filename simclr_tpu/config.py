@@ -441,6 +441,16 @@ def _check_runtime_conf(cfg: Config) -> None:
         in ("replicated", "sharded"),
         "runtime.dataset_residency must be 'replicated' or 'sharded'",
     )
+    # the one true universe lives in ops/augment_pallas.AUGMENT_IMPLS; the
+    # import is lazy so merely validating a config stays jax-free
+    impl = cfg.select("runtime.augment_impl", "xla")
+    from simclr_tpu.ops.augment_pallas import AUGMENT_IMPLS
+
+    _require(
+        impl in AUGMENT_IMPLS,
+        f"runtime.augment_impl must be {'|'.join(AUGMENT_IMPLS)}, "
+        f"got {impl!r}",
+    )
     k = cfg.select("runtime.epochs_per_compile", 1)
     _require(
         isinstance(k, int) and not isinstance(k, bool) and k >= 1,

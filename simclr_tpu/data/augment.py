@@ -306,8 +306,15 @@ def simclr_augment_single(
     strength: float = 0.5,
     out_size: int = 32,
 ) -> jnp.ndarray:
-    """One stochastic SimCLR view of one image (HWC uint8 or float [0,1])."""
-    image = to_float(image)
+    """One stochastic SimCLR view of one image (HWC float32 in [0, 1]).
+
+    Callers convert uint8 once per IMAGE via :func:`to_float` before
+    vmapping this over views (it used to live here, paying the dequant once
+    per view); the fused Pallas kernel (``ops/augment_pallas.py``)
+    dequantizes in-VMEM instead and never materializes the float image in
+    HBM at all.
+    """
+    image = image.astype(jnp.float32)
     k_crop, k_flip, k_apply, k_jitter, k_gray = _view_keys(key)
     image = random_resized_crop(k_crop, image, out_size=out_size)
     image = random_hflip(k_flip, image, p=_HFLIP_P)
@@ -328,8 +335,10 @@ def simclr_two_views(
 
     Mirrors ``SimCLRTransforms.__call__`` returning two independent draws
     (``/root/reference/dataset.py:49-50``), vectorized over the batch with
-    per-example PRNG keys.
+    per-example PRNG keys. uint8 input converts to float ONCE here (not
+    once per view — :func:`simclr_augment_single` takes floats).
     """
+    images = to_float(images)
     n = images.shape[0]
     keys = jax.random.split(key, 2 * n)
     aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))

@@ -184,6 +184,7 @@ def run_pretrain(cfg: Config) -> dict:
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
         grad_elements=param_count(state.params),
         allreduce_devices=n_data,
+        augment_impl=str(cfg.select("runtime.augment_impl", "xla")),
     )
     events = EventLog(
         save_dir,
@@ -263,6 +264,10 @@ def run_pretrain(cfg: Config) -> dict:
         comm_chunks=int(
             cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
         ),
+        # runtime.augment_impl: xla | fused — fused runs both views through
+        # the Pallas one-VMEM-pass kernel (ops/augment_pallas.py,
+        # docs/PERF.md §"Fused augmentation")
+        augment_impl=str(cfg.select("runtime.augment_impl", "xla")),
         # obs/compile.py recompile sentry: the builders route the jitted
         # step through an instrumented AOT lower/compile path when set
         sentry=sentry,
@@ -399,6 +404,7 @@ def run_pretrain(cfg: Config) -> dict:
                 grad_allreduce=step_kwargs["grad_allreduce"],
                 comm_overlap=step_kwargs["comm_overlap"],
                 comm_chunks=step_kwargs["comm_chunks"],
+                augment_impl=step_kwargs["augment_impl"],
             )
             if sentry is not None:
                 # the TP builders predate the sentry kwarg; wrap at the
@@ -420,6 +426,7 @@ def run_pretrain(cfg: Config) -> dict:
                     grad_allreduce=step_kwargs["grad_allreduce"],
                     comm_overlap=step_kwargs["comm_overlap"],
                     comm_chunks=step_kwargs["comm_chunks"],
+                    augment_impl=step_kwargs["augment_impl"],
                     monitor=probe_local,
                 )
                 if sentry is not None:
@@ -447,6 +454,7 @@ def run_pretrain(cfg: Config) -> dict:
                 grad_allreduce=step_kwargs["grad_allreduce"],
                 comm_overlap=step_kwargs["comm_overlap"],
                 comm_chunks=step_kwargs["comm_chunks"],
+                augment_impl=step_kwargs["augment_impl"],
             )
             if sentry is not None:
                 step_fn = sentry.watch(step_fn, "pretrain_step")

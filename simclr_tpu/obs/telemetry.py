@@ -38,12 +38,19 @@ STEP_TIME_BUCKETS = (
 )
 
 
-def _roofline_flops_per_step(arch: str, per_device_batch: int, d: int) -> float | None:
+def _roofline_flops_per_step(
+    arch: str, per_device_batch: int, d: int, augment_impl: str = "xla"
+) -> float | None:
     """Total FLOPs of one per-device train step from the roofline model.
 
     ``scripts/`` is not a package, so the model is loaded by file path
     relative to the repo root; an installed-without-scripts tree degrades to
     ``None`` (MFU gauge stays 0) rather than failing the run.
+
+    ``augment_impl`` selects the augmentation row's byte accounting (the
+    fused Pallas kernel reclaims HBM bandwidth); the step's FLOPs are
+    impl-invariant today, but threading the knob keeps the live MFU and
+    drift gauges attributed to the program actually running.
     """
     import importlib.util
 
@@ -57,7 +64,12 @@ def _roofline_flops_per_step(arch: str, per_device_batch: int, d: int) -> float 
         module = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(module)
         return float(
-            sum(op[1] for op in module.model_step(arch, per_device_batch, d=d))
+            sum(
+                op[1]
+                for op in module.model_step(
+                    arch, per_device_batch, d=d, augment_impl=augment_impl
+                )
+            )
         )
     except Exception:
         return None
@@ -87,13 +99,15 @@ class Telemetry:
         grad_allreduce: str = "exact",
         grad_elements: int | None = None,
         allreduce_devices: int | None = None,
+        augment_impl: str = "xla",
         peak_flops: float = PEAK_FLOPS,
     ):
         self.global_batch = int(global_batch)
         self.n_devices = max(int(n_devices), 1)
         self.peak_flops = float(peak_flops)
         self.flops_per_step = (
-            _roofline_flops_per_step(arch, per_device_batch, d) if arch else None
+            _roofline_flops_per_step(arch, per_device_batch, d, augment_impl)
+            if arch else None
         )
         self._lock = threading.Lock()
 

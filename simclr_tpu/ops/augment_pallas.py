@@ -1,0 +1,316 @@
+"""Pallas TPU kernel: fused two-view SimCLR augmentation in one VMEM pass.
+
+The XLA augmentation path (``data/augment.py`` vmapped per example) is
+correct but traffic-heavy: each view materializes the per-example bilinear
+weight matrices and several batch-sized float32 temporaries in HBM, and the
+uint8 source rows are re-read per view — measured at 2.2 ms for 1024 images
+(~7% of the step, docs/PERF.md). This kernel reads a tile of resident
+**uint8** rows into VMEM once and emits BOTH augmented float32 views in a
+single pass: in-VMEM dequant (``to_float`` semantics, uint8 never touches
+HBM as float), the two bilinear crop/resize contractions, horizontal flip,
+the random-order color jitter, and grayscale — no per-stage HBM
+intermediates. Same discipline as ``ops/ntxent_pallas.py``: keep the hot
+tensor in VMEM, never round-trip HBM.
+
+Randomness stays single-sourced and bit-identical to the XLA path: every
+stochastic parameter (crop box, flip/jitter/grayscale gates, jitter factors
+and op order) is sampled OUTSIDE the kernel by the exact samplers the XLA
+path uses — ``_view_keys`` → ``_sample_crop_box`` / ``jitter_params`` in
+``data/augment.py``, consumed in the same key order — so the distribution
+tests keep measuring the one true sampler and a knob flip changes the
+schedule, not the draw. The kernel is a pure deterministic function of
+(uint8 tile, per-view parameter rows).
+
+The bilinear weights are rebuilt in-VMEM from the 4 crop-box scalars via
+iota comparisons (equal to ``_axis_resize_weights``' scatter-add form,
+including the clipped ``i0 == i1`` edge where both taps land on one column
+and sum to 1), so the kernel's inputs per view are just
+``(batch, _N_PARAMS)`` floats instead of ``(batch, out, H)+(batch, out, W)``
+weight tensors.
+
+Runs compiled on TPU; everywhere else (CPU tests) falls back to
+``interpret=True`` automatically, exactly like ``ntxent_pallas``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from simclr_tpu.data import augment as _aug
+
+# runtime.augment_impl universe — config validation and the builders both
+# import this tuple so the error message and the dispatch can't drift
+AUGMENT_IMPLS = ("xla", "fused")
+
+# per-view parameter row: crop box (top, left, h, w) + flip/apply/gray gates
+# + jitter factors (brightness, contrast, saturation, hue) + the 4-slot op
+# order (the _JITTER_PERMS row, exact small ints in float32)
+_N_PARAMS = 15
+
+
+def validate_impl(impl: str) -> str:
+    if impl not in AUGMENT_IMPLS:
+        raise ValueError(
+            f"augment_impl must be {'|'.join(AUGMENT_IMPLS)}, got {impl!r}"
+        )
+    return impl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _tile_and_pad(n: int) -> tuple[int, int]:
+    """(tile, padded_n) over the batch axis.
+
+    Small batches round up to a multiple of 8 so one tile covers everything;
+    large batches tile at 32 rows (≈0.4 MiB of uint8 source + ≈3 MiB of f32
+    working set per view — comfortably inside VMEM with both views live).
+    Padded tail rows carry zero parameter rows (a degenerate but finite
+    crop) and are sliced off after the call.
+    """
+    tile = 32 if n >= 32 else -(-n // 8) * 8
+    return tile, -(-n // tile) * tile
+
+
+def _pad_rows(x: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    n = x.shape[0]
+    if n == n_pad:
+        return x
+    pad_widths = [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad_widths)
+
+
+# ---------------------------------------------------------------------------
+# parameter precompute (plain JAX, outside the kernel)
+# ---------------------------------------------------------------------------
+
+def _view_params(
+    keys: jnp.ndarray, height: int, width: int, strength: float
+) -> jnp.ndarray:
+    """(n, _N_PARAMS) float32 parameter rows for one view.
+
+    Consumes each per-view key exactly as ``simclr_augment_single`` does —
+    ``_view_keys`` then crop box / flip gate / apply gate / jitter params /
+    grayscale gate — through module-attribute lookups, so monkeypatched spy
+    tests observe the same sampler calls the XLA path makes.
+    """
+
+    def one(key):
+        k_crop, k_flip, k_apply, k_jitter, k_gray = _aug._view_keys(key)
+        top, left, crop_h, crop_w = _aug._sample_crop_box(k_crop, height, width)
+        flip = jax.random.uniform(k_flip) < _aug._HFLIP_P
+        apply = jax.random.uniform(k_apply) < _aug._JITTER_APPLY_P
+        f_b, f_c, f_s, f_h, perm_idx = _aug.jitter_params(k_jitter, strength)
+        gray = jax.random.uniform(k_gray) < _aug._GRAYSCALE_P
+        perm = jnp.asarray(_aug._JITTER_PERMS)[perm_idx].astype(jnp.float32)
+        head = jnp.stack(
+            [
+                top, left, crop_h, crop_w,
+                flip.astype(jnp.float32),
+                apply.astype(jnp.float32),
+                f_b, f_c, f_s, f_h,
+                gray.astype(jnp.float32),
+            ]
+        ).astype(jnp.float32)
+        return jnp.concatenate([head, perm])
+
+    return jax.vmap(one)(keys)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel ops (batched over the tile axis, all VMEM-resident)
+# ---------------------------------------------------------------------------
+
+def _axis_weights(origin, size, out_size: int, in_size: int) -> jnp.ndarray:
+    """(tile, out_size, in_size) bilinear weights from per-row box scalars.
+
+    Comparison form of ``augment._axis_resize_weights``' scatter-add: both
+    taps are written via iota equality, so the clipped ``i0 == i1`` border
+    case sums the two taps into one column exactly like ``.at[].add`` does.
+    Index values are small exact integers in float32, so ``==`` is exact.
+    """
+    tn = origin.shape[0]
+    dst = jax.lax.broadcasted_iota(jnp.float32, (tn, out_size), 1)
+    centers = origin[:, None] + (dst + 0.5) * (size[:, None] / out_size) - 0.5
+    centers = jnp.clip(
+        centers, origin[:, None], origin[:, None] + size[:, None] - 1.0
+    )
+    floor = jnp.floor(centers)
+    frac = centers - floor
+    i0 = jnp.clip(floor, 0.0, in_size - 1.0)
+    i1 = jnp.clip(i0 + 1.0, 0.0, in_size - 1.0)
+    src = jax.lax.broadcasted_iota(jnp.float32, (tn, out_size, in_size), 2)
+    return (src == i0[..., None]).astype(jnp.float32) * (
+        1.0 - frac[..., None]
+    ) + (src == i1[..., None]).astype(jnp.float32) * frac[..., None]
+
+
+def _luma(img: jnp.ndarray) -> jnp.ndarray:
+    w = _aug._GRAY_WEIGHTS  # ITU-R 601, the XLA path's constants
+    return img[..., 0] * w[0] + img[..., 1] * w[1] + img[..., 2] * w[2]
+
+
+def _gray3(img: jnp.ndarray) -> jnp.ndarray:
+    return _luma(img)[..., None] * jnp.ones((3,), jnp.float32)
+
+
+def _brightness(img, f):
+    return jnp.clip(img * f, 0.0, 1.0)
+
+
+def _contrast(img, f):
+    # per-example mean of the grayscale image (augment.adjust_contrast
+    # semantics, batched over the tile axis)
+    mean = _luma(img).mean(axis=(1, 2)).reshape(-1, 1, 1, 1)
+    return jnp.clip(mean + f * (img - mean), 0.0, 1.0)
+
+
+def _saturation(img, f):
+    g = _gray3(img)
+    return jnp.clip(g + f * (img - g), 0.0, 1.0)
+
+
+def _augment_tile(x, p, out_size: int, height: int, width: int):
+    """Both-crop-to-gray chain for one view over one VMEM tile.
+
+    ``x``: (tile, H, W, 3) float32 in [0, 1]; ``p``: (tile, _N_PARAMS).
+    Mirrors ``simclr_augment_single`` stage for stage; the per-example
+    ``lax.switch`` over jitter ops becomes compute-all-and-select, which is
+    what vmap lowers the switch to anyway.
+    """
+    tn = x.shape[0]
+    w_rows = _axis_weights(p[:, 0], p[:, 2], out_size, height)
+    w_cols = _axis_weights(p[:, 1], p[:, 3], out_size, width)
+    y = jnp.einsum(
+        "toh,thwc->towc", w_rows, x, preferred_element_type=jnp.float32
+    )
+    y = jnp.einsum(
+        "tpw,towc->topc", w_cols, y, preferred_element_type=jnp.float32
+    )
+    flip = p[:, 4].reshape(tn, 1, 1, 1) > 0.5
+    y = jnp.where(flip, jnp.flip(y, axis=2), y)
+
+    f_b = p[:, 6].reshape(tn, 1, 1, 1)
+    f_c = p[:, 7].reshape(tn, 1, 1, 1)
+    f_s = p[:, 8].reshape(tn, 1, 1, 1)
+    f_h = p[:, 9].reshape(tn, 1, 1)
+    jit = y
+    for slot in range(4):
+        op = p[:, 11 + slot].reshape(tn, 1, 1, 1)
+        jit = jnp.where(
+            op == 0.0,
+            _brightness(jit, f_b),
+            jnp.where(
+                op == 1.0,
+                _contrast(jit, f_c),
+                jnp.where(
+                    op == 2.0,
+                    _saturation(jit, f_s),
+                    _aug.adjust_hue(jit, f_h),
+                ),
+            ),
+        )
+    apply = p[:, 5].reshape(tn, 1, 1, 1) > 0.5
+    y = jnp.where(apply, jit, y)
+    gray = p[:, 10].reshape(tn, 1, 1, 1) > 0.5
+    return jnp.where(gray, _gray3(y), y)
+
+
+def _augment_kernel(*refs, out_size, height, width, scale, n_views):
+    """Grid step: one batch tile. Refs: n_views param blocks, the image
+    block, then n_views output blocks. The source tile is loaded and
+    dequantized ONCE (``scale`` = 1/255 for uint8 inputs — this is where
+    ``to_float`` happens, in VMEM); every view reads the same registers.
+    """
+    img_ref = refs[n_views]
+    outs = refs[n_views + 1:]
+    x = img_ref[:].astype(jnp.float32) * scale
+    for v in range(n_views):
+        outs[v][:] = _augment_tile(x, refs[v][:], out_size, height, width)
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def _fused_views(images, keys_per_view, strength: float, out_size: int):
+    n, height, width, channels = images.shape
+    if channels != 3:
+        raise ValueError(
+            f"fused augmentation expects RGB (N, H, W, 3), got {images.shape}"
+        )
+    scale = 1.0 / 255.0 if images.dtype == jnp.uint8 else 1.0
+    if images.dtype != jnp.uint8:
+        images = images.astype(jnp.float32)
+    params = [
+        _view_params(k, height, width, strength) for k in keys_per_view
+    ]
+    tn, n_pad = _tile_and_pad(n)
+    imgs = _pad_rows(images, n_pad)
+    params = [_pad_rows(p, n_pad) for p in params]
+    n_views = len(params)
+    kernel = functools.partial(
+        _augment_kernel,
+        out_size=out_size,
+        height=height,
+        width=width,
+        scale=scale,
+        n_views=n_views,
+    )
+    views = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tn,),
+        in_specs=[pl.BlockSpec((tn, _N_PARAMS), lambda i: (i, 0))] * n_views
+        + [pl.BlockSpec((tn, height, width, channels), lambda i: (i, 0, 0, 0))],
+        out_specs=[
+            pl.BlockSpec(
+                (tn, out_size, out_size, channels), lambda i: (i, 0, 0, 0)
+            )
+        ]
+        * n_views,
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (n_pad, out_size, out_size, channels), jnp.float32
+            )
+        ]
+        * n_views,
+        interpret=_interpret(),
+    )(*params, imgs)
+    return tuple(v[:n] for v in views)
+
+
+def fused_two_views(
+    rng: jax.Array,
+    images: jnp.ndarray,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Both SimCLR views of a uint8 (or float) batch in one VMEM pass.
+
+    Key schedule is identical to ``steps._augment_two_views``' XLA path:
+    ``split(rng, 2n)``, first half view 0, second half view 1 — so equal
+    seeds draw bit-identical augmentation parameters on either impl.
+    """
+    n = images.shape[0]
+    keys = jax.random.split(rng, 2 * n)
+    v0, v1 = _fused_views(images, (keys[:n], keys[n:]), strength, out_size)
+    return v0, v1
+
+
+def fused_one_view(
+    rng: jax.Array,
+    images: jnp.ndarray,
+    strength: float = 0.5,
+    out_size: int = 32,
+) -> jnp.ndarray:
+    """Single augmented view (the supervised baseline's consumption —
+    ``split(rng, n)``, same key schedule as its XLA path)."""
+    n = images.shape[0]
+    keys = jax.random.split(rng, n)
+    (view,) = _fused_views(images, (keys,), strength, out_size)
+    return view

@@ -40,6 +40,11 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from simclr_tpu.data.augment import simclr_augment_single, to_float
+from simclr_tpu.ops.augment_pallas import (
+    fused_one_view,
+    fused_two_views,
+    validate_impl as validate_augment_impl,
+)
 from simclr_tpu.ops.ntxent import (
     ntxent_loss_local_negatives,
     ntxent_loss_sharded_rows,
@@ -240,8 +245,20 @@ def check_epoch_compile_preconditions(
     return resident_bytes
 
 
-def _augment_two_views(rng, images, strength, out_size):
-    """Two on-device SimCLR views of the local uint8 shard."""
+def _augment_two_views(rng, images, strength, out_size, augment_impl="xla"):
+    """Two on-device SimCLR views of the local uint8 shard.
+
+    ``augment_impl="xla"`` is the vmapped per-example chain, converting
+    uint8→f32 once per IMAGE (hoisted out of ``simclr_augment_single``, not
+    paid per view); ``"fused"`` routes through the Pallas one-VMEM-pass
+    kernel (``ops/augment_pallas.py``), which dequantizes in-VMEM and emits
+    both views from one read of the uint8 tile. Both impls consume the same
+    key schedule (``split(rng, 2n)``, first half view 0) and the same
+    samplers, so equal seeds draw bit-identical augmentation parameters.
+    """
+    if augment_impl == "fused":
+        return fused_two_views(rng, images, strength, out_size)
+    images = to_float(images)
     n = images.shape[0]
     keys = jax.random.split(rng, 2 * n)
     aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
@@ -305,6 +322,7 @@ def _make_local_pretrain_step(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
 ):
     """The per-replica contrastive step, shared verbatim by the
     dispatch-per-step (:func:`make_pretrain_step`) and epoch-compiled
@@ -323,6 +341,7 @@ def _make_local_pretrain_step(
     """
     compress.validate_mode(grad_allreduce)
     compress.validate_overlap(comm_overlap, comm_chunks)
+    validate_augment_impl(augment_impl)
     if negatives not in ("global", "local", "ring"):
         raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
     if forward_mode not in ("two_pass", "concat"):
@@ -339,7 +358,7 @@ def _make_local_pretrain_step(
 
     def local_step(state: TrainState, images: jnp.ndarray, rng: jax.Array):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-        v0, v1 = _augment_two_views(rng, images, strength, out_size)
+        v0, v1 = _augment_two_views(rng, images, strength, out_size, augment_impl)
 
         def loss_fn(params):
             z0, z1, new_stats = apply_views(forward, params, state.batch_stats, v0, v1)
@@ -391,6 +410,7 @@ def make_pretrain_step(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
     sentry=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, Metrics]]:
     """Build the jitted contrastive train step.
@@ -412,6 +432,7 @@ def make_pretrain_step(
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
         grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     sharded = shard_map(
         local_step,
@@ -441,6 +462,7 @@ def make_pretrain_epoch_fn(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled training: one XLA program per EPOCH, zero host work
@@ -480,6 +502,7 @@ def make_pretrain_epoch_fn(
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
         grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     return _watch(
         _make_epoch_fn(per_step, mesh, n_arrays=1, residency=residency),
@@ -739,6 +762,7 @@ def make_pretrain_superepoch_fn(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
     monitor=None,
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
@@ -761,6 +785,7 @@ def make_pretrain_superepoch_fn(
         fused=fused, forward_mode=forward_mode, remat=remat, out_size=out_size,
         grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     idx_pos = 1 + 1 + (3 if monitor is not None else 0)
     return _watch(
@@ -776,17 +801,22 @@ def make_pretrain_superepoch_fn(
 def _make_local_supervised_step(
     model, tx, *, strength: float, out_size: int, grad_allreduce: str = "exact",
     comm_overlap: str = "off", comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
 ):
     """Per-replica supervised CE step, shared by the dispatch-per-step and
     epoch-compiled paths (see :func:`_make_local_pretrain_step`)."""
     compress.validate_mode(grad_allreduce)
     compress.validate_overlap(comm_overlap, comm_chunks)
+    validate_augment_impl(augment_impl)
 
     def local_step(state: TrainState, images, labels, rng):
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-        keys = jax.random.split(rng, images.shape[0])
-        aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
-        x = aug(keys, images, strength, out_size)
+        if augment_impl == "fused":
+            x = fused_one_view(rng, images, strength, out_size)
+        else:
+            keys = jax.random.split(rng, images.shape[0])
+            aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
+            x = aug(keys, to_float(images), strength, out_size)
 
         def loss_fn(params):
             logits, mut = model.apply(
@@ -831,6 +861,7 @@ def make_supervised_step(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Jitted supervised CE train step (one SimCLR-augmented view).
@@ -843,6 +874,7 @@ def make_supervised_step(
         model, tx, strength=strength, out_size=out_size,
         grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     sharded = shard_map(
         local_step,
@@ -867,6 +899,7 @@ def make_supervised_epoch_fn(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
     sentry=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """Epoch-compiled supervised training (see
@@ -881,6 +914,7 @@ def make_supervised_epoch_fn(
         model, tx, strength=strength, out_size=out_size,
         grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     return _watch(
         _make_epoch_fn(per_step, mesh, n_arrays=2, residency=residency),
@@ -989,7 +1023,7 @@ def make_augmented_encode_step(
     def encode(params, batch_stats, images, rng):
         keys = jax.random.split(rng, images.shape[0])
         aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
-        x = aug(keys, images, strength, out_size)
+        x = aug(keys, to_float(images), strength, out_size)
         variables = {"params": params, "batch_stats": batch_stats}
         if use_full_encoder:
             return model.apply(variables, x, train=False).astype(jnp.float32)

@@ -47,6 +47,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from simclr_tpu.models.resnet import feature_dim
+from simclr_tpu.ops.augment_pallas import validate_impl as validate_augment_impl
 from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
 from simclr_tpu.parallel import compress
 from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, axis_size, shard_map
@@ -133,6 +134,7 @@ def _make_step_body(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
 ):
     """The un-jitted TP step: shard_map'ed forward/backward + jit-level
     optimizer update. Shared by the dispatch-per-step and epoch-compiled
@@ -151,6 +153,7 @@ def _make_step_body(
     still dequantize identical gradients."""
     compress.validate_mode(grad_allreduce)
     compress.validate_overlap(comm_overlap, comm_chunks)
+    validate_augment_impl(augment_impl)
     tp = mesh.shape[MODEL_AXIS]
     local_model = _local_view(model, tp)
     fwd = _forward_fn(local_model, remat)  # the dp step's forward/remat recipe
@@ -159,7 +162,7 @@ def _make_step_body(
         # the dp step's exact augmentation recipe (steps.py): keys depend on
         # the DATA shard index only, so model-axis replicas agree
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-        v0, v1 = _augment_two_views(rng, images, strength, out_size)
+        v0, v1 = _augment_two_views(rng, images, strength, out_size, augment_impl)
 
         def loss_fn(p):
             z0, mut = fwd(p, batch_stats, v0)
@@ -219,6 +222,7 @@ def make_pretrain_step_tp(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Contrastive train step with the projection head tensor-parallel over
     the ``model`` mesh axis (global NT-Xent negatives over ``data``).
@@ -233,6 +237,7 @@ def make_pretrain_step_tp(
         temperature=temperature, strength=strength, out_size=out_size,
         remat=remat, grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     return jax.jit(step, donate_argnums=(0,))
 
@@ -250,6 +255,7 @@ def make_pretrain_epoch_fn_tp(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
 ) -> Callable[..., tuple[TrainState, dict]]:
     """Epoch-compiled TP training: ``lax.scan`` over steps at the JIT level.
 
@@ -279,6 +285,7 @@ def make_pretrain_epoch_fn_tp(
         temperature=temperature, strength=strength, out_size=out_size,
         remat=remat, grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     batched = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -328,6 +335,7 @@ def make_pretrain_superepoch_fn_tp(
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
     comm_chunks: int = compress.DEFAULT_COMM_CHUNKS,
+    augment_impl: str = "xla",
     monitor=None,
 ) -> Callable[..., tuple[TrainState, dict]]:
     """Superepoch-compiled TP training: an outer ``lax.scan`` over K epochs
@@ -354,6 +362,7 @@ def make_pretrain_superepoch_fn_tp(
         temperature=temperature, strength=strength, out_size=out_size,
         remat=remat, grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
+        augment_impl=augment_impl,
     )
     batched = NamedSharding(mesh, P(DATA_AXIS))
     array_spec = P() if residency == "replicated" else P(DATA_AXIS)

@@ -229,6 +229,7 @@ def run_supervised(cfg: Config) -> dict:
             comm_chunks=int(
                 cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
             ),
+            augment_impl=str(cfg.select("runtime.augment_impl", "xla")),
             sentry=sentry,
         )
         put_dataset = put_replicated if residency == "replicated" else put_row_sharded
@@ -245,6 +246,7 @@ def run_supervised(cfg: Config) -> dict:
             comm_chunks=int(
                 cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
             ),
+            augment_impl=str(cfg.select("runtime.augment_impl", "xla")),
             sentry=sentry,
         )
         train_iter = EpochIterator(

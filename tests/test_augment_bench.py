@@ -1,0 +1,71 @@
+"""scripts/augment_bench.py contract (the fused-augmentation microbench).
+
+Subprocess runs with ``AUGMENT_BENCH_BATCHES`` pinning a tiny batch so the
+CPU run (Pallas interpret mode) finishes fast; assertions pin the
+one-payload-line robustness contract (bench.py family) and the per-(batch,
+impl) report shape. The headline HBM-reduction number is analytic — a
+quotient of ``roofline_model.augment_bytes`` columns — so it is pinned here
+against the same function the script imports (they cannot disagree).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "scripts", "augment_bench.py")
+
+
+def _run(extra_env=None, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, BENCH],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def _payload_lines(stdout):
+    return [l for l in stdout.splitlines() if l.strip().startswith("{")]
+
+
+def test_reports_both_impls_with_timings_and_hbm_columns():
+    r = _run({"AUGMENT_BENCH_BATCHES": "64", "AUGMENT_BENCH_ITERS": "2"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _payload_lines(r.stdout)
+    assert len(lines) == 1, r.stdout  # exactly one payload line
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "augment_hbm_reduction_fused_vs_xla"
+    assert payload["headline_batch"] == "64"
+    assert payload["recompile_alarms"] == 0  # watcher done-marker requirement
+    assert "error" not in payload
+    impls = payload["batches"]["64"]["impls"]
+    assert set(impls) == {"xla", "fused"}
+    for impl, entry in impls.items():
+        assert entry["ms_per_batch"] > 0.0, impl
+        assert entry["hbm_mb"] > 0.0, impl
+    # fused reads uint8 once + writes two views; xla round-trips f32 per view
+    assert impls["fused"]["hbm_mb"] < impls["xla"]["hbm_mb"]
+    # headline ratio matches the analytic byte quotient it claims
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from roofline_model import augment_bytes
+
+    want = augment_bytes(64, "xla") / augment_bytes(64, "fused")
+    assert abs(payload["value"] - want) < 0.01
+
+
+def test_exhausted_budget_skips_loudly_and_still_emits():
+    r = _run({
+        "AUGMENT_BENCH_BATCHES": "64",
+        "AUGMENT_BENCH_BUDGET_S": "0",
+    })
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _payload_lines(r.stdout)
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "augment_hbm_reduction_fused_vs_xla"
+    assert payload["skipped"], payload  # dropped pairs recorded, not silent
+    assert payload["batches"] == {}

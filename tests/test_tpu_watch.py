@@ -20,9 +20,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
-    "allreduce_bench", "multihost_dryrun", "remat2048", "explore1024",
-    "explore512", "supervisor_smoke", "obs_smoke", "compile_audit",
-    "superepoch", "run_report",
+    "allreduce_bench", "augment_bench", "multihost_dryrun", "remat2048",
+    "explore1024", "explore512", "supervisor_smoke", "obs_smoke",
+    "compile_audit", "superepoch", "run_report",
 )
 
 
@@ -74,6 +74,17 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         '"value": 3.98, "unit": "x", "overlap_chunks": [2, 4, 8], '
         '"models": {"resnet18": {"modes": {"int8": {"ms_per_step": 1.5, '
         '"overlap": {"4": {"ms_per_step": 1.2}}}}}}}\';; esac',
+        # the augment_bench stage greps its stdout for an error-free payload
+        # carrying BOTH per-impl columns and a zero recompile-alarm count
+        # (its script exits 0 even on error); the *bench.py* case below also
+        # substring-matches this invocation, harmlessly re-touching the
+        # capture
+        'case "$*" in *augment_bench.py*) '
+        'echo \'{"metric": "augment_hbm_reduction_fused_vs_xla", '
+        '"value": 2.93, "unit": "x", "headline_batch": "256", '
+        '"recompile_alarms": 0, "batches": {"256": {"impls": '
+        '{"xla": {"ms_per_batch": 2.2, "hbm_mb": 7.5}, '
+        '"fused": {"ms_per_batch": 0.9, "hbm_mb": 2.256}}}}}\';; esac',
         # the multihost_dryrun stage greps its stdout for a 2-process
         # parity payload (its orchestrator also exits 0 on error)
         'case "$*" in *multihost_dryrun.py*) '
@@ -220,6 +231,48 @@ def test_allreduce_marker_requires_overlap_table(tmp_path):
     assert "stage allreduce_bench FAILED" in log.read_text()
     # and the stage really asked for the overlap columns
     assert "allreduce_bench.py --overlap" in calls.read_text()
+
+
+def test_augment_marker_requires_both_impl_columns(tmp_path):
+    """The augment_bench done-marker demands the per-impl table: a payload
+    missing the fused column (budget exhausted before any fused pair ran,
+    or an old-format script) is incomplete evidence and must not earn
+    augment_bench.done — the stage retries next window."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        ', "fused": {"ms_per_batch": 0.9, "hbm_mb": 2.256}', ""))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "augment_bench" not in _done(state)
+    assert (state / "augment_bench.fails").exists()
+    assert "stage augment_bench FAILED" in log.read_text()
+    # the stage sharing the window must be untouched
+    assert "allreduce_bench" in _done(state)
+
+
+def test_augment_marker_requires_quiet_recompiles_and_no_error(tmp_path):
+    """A payload reporting post-warmup recompiles (unstable kernel
+    signature — would alarm CompileSentry in training) must not earn
+    augment_bench.done; neither must the script's last-ditch error
+    payload, which also exits 0."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"recompile_alarms": 0, "batches"',
+        '"recompile_alarms": 2, "batches"'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "augment_bench" not in _done(state)
+    assert (state / "augment_bench.fails").exists()
+    assert "stage augment_bench FAILED" in log.read_text()
+
+    # second contract: quiet recompiles but an error field present
+    stub.write_text(stub.read_text().replace(
+        '"recompile_alarms": 2, "batches"',
+        '"recompile_alarms": 0, "error": "boom", "batches"'))
+    (state / "augment_bench.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "augment_bench" not in _done(state)
+    assert (state / "augment_bench.fails").exists()
 
 
 def test_multihost_marker_requires_two_process_parity(tmp_path):

@@ -1,36 +1,46 @@
-"""Load generator for the embedding server: concurrency sweep -> ONE JSON line.
+"""Load generator for the embedding server: (replicas x load) sweep -> ONE JSON line.
 
-Drives ``POST /v1/embed`` at increasing client concurrency and reports the
-best sustained throughput plus latency quantiles:
+Drives ``POST /v1/embed`` at increasing client concurrency — and, when
+self-hosting, across increasing replica counts — and reports per-cell
+p50/p99 latency + achieved QPS plus a scaling headline:
 
     {"metric": "serve_requests_per_sec", "value": ..., "unit": "req/s",
      "best_concurrency": ..., "p50_ms": ..., "p95_ms": ..., "p99_ms": ...,
-     "levels": {...}, ...}
+     "levels": {...}, "cells": {"r1": {...}, "r2": {...}},
+     "scaling": {"replicas": 2, "single_rps": ..., "multi_rps": ...,
+                 "speedup": ...}, "recompile_alarms": 0, ...}
 
 Two modes:
 
   * ``SERVE_BENCH_URL=http://host:port`` — benchmark a server you already
     started (``python -m simclr_tpu.serve ...``); the generator is pure
-    stdlib and imports no jax.
-  * no URL — self-host: build an in-process server around a RANDOM-INIT
-    eval model (resnet18 by default; weights don't matter for throughput)
-    on whatever backend JAX_PLATFORMS selects, sweep against it, tear it
-    down. No checkpoint required, so the script runs anywhere the test
-    suite runs.
+    stdlib and imports no jax. Replica count is whatever that server runs.
+  * no URL — self-host: for each count in ``SERVE_BENCH_REPLICAS`` build an
+    in-process ReplicaPool server, sweep the concurrency levels against it,
+    tear it down. The pool holds either a RANDOM-INIT eval model (resnet18
+    by default; weights don't matter for throughput) or — with
+    ``SERVE_BENCH_SYNTH_MS`` — synthetic engines whose ``embed`` sleeps
+    that many milliseconds PER ROW. Per-row (not per-call) cost keeps the
+    scaling measurement honest: a per-call constant would let one replica
+    erase the fan-out advantage by coalescing deeper, and ``sleep``
+    releases the GIL so N workers genuinely overlap on CPU. Synthetic mode
+    imports no jax and needs no devices.
 
 Robustness contract (same as bench.py): this script NEVER exits nonzero and
 NEVER prints a traceback as its last line; it emits EXACTLY ONE payload
 line. A total wall-clock budget (``SERVE_BENCH_BUDGET_S``, default 180 s)
-clips the sweep — levels that don't fit are dropped and recorded under
-``"skipped_levels"`` rather than silently missing — and a SIGTERM at any
-point emits the best-so-far payload before exiting 0.
+clips the sweep — (replicas, concurrency) cells that don't fit are dropped
+and recorded under ``"skipped_cells"`` rather than silently missing — and a
+SIGTERM at any point emits the best-so-far payload before exiting 0.
 
 Env knobs: ``SERVE_BENCH_URL``, ``SERVE_BENCH_CONCURRENCY`` (default
-``1,2,4,8``), ``SERVE_BENCH_ROWS`` (rows per request, default 1),
+``1,2,4,8``), ``SERVE_BENCH_REPLICAS`` (self-host, default ``1``),
+``SERVE_BENCH_ROWS`` (rows per request, default 1),
 ``SERVE_BENCH_DURATION_S`` (seconds per level, default 5),
 ``SERVE_BENCH_BUDGET_S``, ``SERVE_BENCH_MAX_BATCH`` (self-host, default 32),
 ``SERVE_BENCH_TINY`` (self-host with the test suite's tiny model instead of
-resnet18).
+resnet18), ``SERVE_BENCH_SYNTH_MS`` (self-host synthetic per-row engine),
+``SERVE_BENCH_WEIGHTS`` (self-host weight storage: exact|bf16|int8).
 """
 
 from __future__ import annotations
@@ -49,6 +59,7 @@ from urllib.parse import urlparse
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DEFAULT_CONCURRENCY = "1,2,4,8"
+DEFAULT_REPLICAS = "1"
 DEFAULT_ROWS = 1
 DEFAULT_DURATION_S = 5.0
 DEFAULT_BUDGET_S = 180.0
@@ -199,15 +210,105 @@ def assemble_payload(levels: list[dict], rows: int, extra: dict) -> dict:
     return payload
 
 
-def self_hosted_server(max_batch: int):
-    """(server, batcher, serve_forever-thread, extra-provenance) around a
-    random-init model — throughput needs a real forward, not real weights."""
+class _SyntheticEngine:
+    """Engine stand-in whose ``embed`` costs ``per_row_ms`` PER ROW.
+
+    Per-row (not per-call) cost is the honesty requirement for the scaling
+    measurement — see the module docstring. ``time.sleep`` releases the
+    GIL, so one synthetic engine per batcher worker overlaps like real
+    device compute does. No jax, no devices.
+    """
+
+    def __init__(self, replica_id: int, max_batch: int, per_row_ms: float, dim: int = 32):
+        self.replica_id = replica_id
+        self.max_batch = int(max_batch)
+        self.per_row_ms = float(per_row_ms)
+        self.feature_dim = dim
+        self.input_shape = (32, 32, 3)
+        self.weights_mode = "synthetic"
+        buckets, b = [], 1
+        while b < self.max_batch:
+            buckets.append(b)
+            b *= 2
+        self.buckets = tuple(buckets + [self.max_batch])
+        self.last_spans: tuple = ()
+
+    def embed(self, images):
+        n = images.shape[0]
+        t0 = time.perf_counter()
+        time.sleep(n * self.per_row_ms / 1000.0)
+        done = time.perf_counter()
+        self.last_spans = (("pad", t0, t0), ("device_compute", t0, done))
+        out = [[0.0] * self.feature_dim for _ in range(n)]
+        try:
+            import numpy as np
+
+            return np.zeros((n, self.feature_dim), np.float32)
+        except ImportError:  # pragma: no cover - numpy is always present
+            return out
+
+    def warm_state(self):
+        return list(self.buckets)
+
+    def weight_hbm_bytes(self) -> int:
+        return 0
+
+    def weight_hbm_analytic_bytes(self) -> int:
+        return 0
+
+
+def _build_pool(max_batch: int, replicas: int, metrics):
+    """A ReplicaPool of ``replicas`` engines + provenance dict."""
+    from simclr_tpu.serve.replica import ReplicaPool
+
+    synth_ms = float(os.environ.get("SERVE_BENCH_SYNTH_MS", 0) or 0)
+    if synth_ms > 0:
+        pool = ReplicaPool(
+            [_SyntheticEngine(r, max_batch, synth_ms) for r in range(replicas)]
+        )
+        return pool, {"model": f"synthetic-{synth_ms:g}ms-per-row", "backend": "none"}
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    weights = os.environ.get("SERVE_BENCH_WEIGHTS", "exact")
+    if os.environ.get("SERVE_BENCH_TINY"):
+        from tests.helpers import TinyContrastive
+
+        model = TinyContrastive(bn_cross_replica_axis=None)
+        model_name = "tiny-random-init"
+    else:
+        from simclr_tpu.config import load_config
+        from simclr_tpu.eval import build_eval_model
+
+        cfg = load_config("serve", overrides=["experiment.target_dir=unused"])
+        model = build_eval_model(cfg)
+        model_name = f"{cfg.experiment.base_cnn}-random-init"
+    variables = jax.tree.map(
+        np.asarray,
+        model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3), jnp.float32)),
+    )
+    pool = ReplicaPool.from_model(
+        model,
+        variables,
+        replicas=replicas,
+        max_batch=max_batch,
+        metrics=metrics,
+        weights=weights,
+    )
+    return pool, {
+        "model": model_name,
+        "backend": jax.default_backend(),
+        "weights": weights,
+    }
+
+
+def self_hosted_server(max_batch: int, replicas: int = 1):
+    """(server, batcher, thread, extra, metrics) around a ``replicas``-wide
+    pool — random-init or synthetic; throughput needs a real (or honestly
+    modeled) forward, not real weights."""
     from simclr_tpu.config import load_config
-    from simclr_tpu.serve.engine import EmbedEngine
     from simclr_tpu.serve.metrics import ServeMetrics
     from simclr_tpu.serve.server import start_server
 
@@ -219,36 +320,22 @@ def self_hosted_server(max_batch: int):
             "experiment.target_dir=unused-self-hosted",
         ],
     )
-    if os.environ.get("SERVE_BENCH_TINY"):
-        from tests.helpers import TinyContrastive
-
-        model = TinyContrastive(bn_cross_replica_axis=None)
-        model_name = "tiny-random-init"
-    else:
-        from simclr_tpu.eval import build_eval_model
-
-        model = build_eval_model(cfg)
-        model_name = f"{cfg.experiment.base_cnn}-random-init"
-    variables = jax.tree.map(
-        np.asarray,
-        model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3), jnp.float32)),
-    )
     metrics = ServeMetrics()
-    print(f"# self-hosting {model_name}, warming {max_batch=} buckets...",
+    print(f"# self-hosting {replicas} replica(s), warming {max_batch=} buckets...",
           file=sys.stderr)
-    engine = EmbedEngine(model, variables, max_batch=max_batch, metrics=metrics)
-    server, batcher = start_server(cfg, engine=engine, metrics=metrics)
+    pool, extra = _build_pool(max_batch, replicas, metrics)
+    server, batcher = start_server(cfg, pool=pool, metrics=metrics)
     thread = threading.Thread(
         target=server.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
     )
     thread.start()
     extra = {
         "self_hosted": True,
-        "model": model_name,
-        "backend": jax.default_backend(),
         "max_batch": max_batch,
+        "replicas": replicas,
+        **extra,
     }
-    return server, batcher, thread, extra
+    return server, batcher, thread, extra, metrics
 
 
 def main() -> None:
@@ -270,18 +357,10 @@ def main() -> None:
     ]
 
     url = os.environ.get("SERVE_BENCH_URL")
-    server = thread = None
     if url:
         parsed = urlparse(url if "//" in url else f"http://{url}")
         host, port = parsed.hostname, parsed.port or 80
         extra = {"self_hosted": False, "target": f"{host}:{port}"}
-    else:
-        server, _batcher, thread, extra = self_hosted_server(
-            int(os.environ.get("SERVE_BENCH_MAX_BATCH", 32))
-        )
-        host, port = server.server_address[:2]
-
-    try:
         levels: list[dict] = []
         skipped: list[int] = []
         for c in concurrency_levels:
@@ -301,14 +380,91 @@ def main() -> None:
             print(f"# budget exhausted; skipped concurrency levels {skipped}",
                   file=sys.stderr)
         _emit_payload(payload)
-    finally:
-        if server is not None:
-            from simclr_tpu.serve.server import shutdown_gracefully
+        return
 
-            shutdown_gracefully(server, drain_timeout_s=10)
-            if thread is not None:
-                thread.join(timeout=10)
-            server.server_close()
+    # self-host: sweep replicas x concurrency, one pool server per count
+    replica_levels = sorted(
+        {
+            int(r)
+            for r in os.environ.get("SERVE_BENCH_REPLICAS", DEFAULT_REPLICAS).split(",")
+            if r.strip()
+        }
+    )
+    max_batch = int(os.environ.get("SERVE_BENCH_MAX_BATCH", 32))
+    cells: dict[str, dict] = {}
+    skipped_cells: list[list[int]] = []
+    alarms = 0
+    extra: dict = {}
+    best_rps: dict[int, float] = {}
+    for n_replicas in replica_levels:
+        budget_left = deadline - time.monotonic() - EMIT_RESERVE_S
+        if budget_left < 2.0:
+            skipped_cells.extend([n_replicas, c] for c in concurrency_levels)
+            print(f"# budget exhausted; skipped ALL cells at replicas={n_replicas}",
+                  file=sys.stderr)
+            continue
+        server = thread = None
+        try:
+            server, _batcher, thread, extra, metrics = self_hosted_server(
+                max_batch, n_replicas
+            )
+            host, port = server.server_address[:2]
+            levels = []
+            for c in concurrency_levels:
+                budget_left = deadline - time.monotonic() - EMIT_RESERVE_S
+                if budget_left < 1.0:
+                    skipped_cells.append([n_replicas, c])
+                    print(f"# budget exhausted; skipped cell "
+                          f"replicas={n_replicas} concurrency={c}", file=sys.stderr)
+                    continue
+                level = run_level(host, port, c, rows, min(duration_s, budget_left))
+                levels.append(level)
+                print(f"# replicas={n_replicas} level {level}", file=sys.stderr)
+                cells[f"r{n_replicas}"] = {str(r["concurrency"]): r for r in levels}
+                _BEST_SO_FAR = _scaled_payload(
+                    cells, skipped_cells, best_rps, alarms, rows, extra, levels
+                )
+            alarms = max(alarms, int(metrics.recompile_alarms_total.value))
+            if levels:
+                best_rps[n_replicas] = max(r["requests_per_sec"] for r in levels)
+        finally:
+            if server is not None:
+                from simclr_tpu.serve.server import shutdown_gracefully
+
+                shutdown_gracefully(server, drain_timeout_s=10)
+                if thread is not None:
+                    thread.join(timeout=10)
+                server.server_close()
+    levels = list(cells.get(f"r{max(best_rps)}", {}).values()) if best_rps else []
+    payload = _scaled_payload(
+        cells, skipped_cells, best_rps, alarms, rows, extra, levels
+    )
+    if skipped_cells:
+        print(f"# budget exhausted; skipped (replicas, concurrency) cells "
+              f"{skipped_cells}", file=sys.stderr)
+    _emit_payload(payload)
+
+
+def _scaled_payload(cells, skipped_cells, best_rps, alarms, rows, extra, levels) -> dict:
+    """Full payload: headline from the widest measured replica count, plus
+    the per-cell table, the scaling summary, and the alarm count."""
+    payload = assemble_payload(levels, rows, extra)
+    payload["cells"] = cells
+    payload["recompile_alarms"] = int(alarms)
+    if skipped_cells:
+        payload["skipped_cells"] = skipped_cells
+    if best_rps:
+        r_lo, r_hi = min(best_rps), max(best_rps)
+        payload["replicas"] = r_hi
+        payload["scaling"] = {
+            "replicas": r_hi,
+            "single_rps": best_rps[r_lo],
+            "multi_rps": best_rps[r_hi],
+            "speedup": round(best_rps[r_hi] / best_rps[r_lo], 2)
+            if best_rps[r_lo] > 0
+            else 0.0,
+        }
+    return payload
 
 
 if __name__ == "__main__":

@@ -54,7 +54,7 @@ MAX_STAGE_FAILS=3
 # chip lock — proves the pod code path on the host), then the remaining
 # step matrices, and last the supervisor kill/resume smoke (fault
 # tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench augment_bench multihost_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench augment_bench multihost_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -321,6 +321,31 @@ run_stage() {
                 grep -Eq '^superepoch_parity OK' "$out" \
                     && grep -Eq '^superepoch_compiles_total [1-9][0-9]*$' "$out" \
                     && grep -Eq '^superepoch_recompile_alarms_total 0$' "$out"
+                rc=$?
+            fi ;;
+        serve_scale)
+            # replica fan-out scaling evidence (scripts/serve_bench.py):
+            # a multi-replica ReplicaPool server must beat one replica at
+            # saturating offered load. Synthetic per-row engines keep this
+            # CPU-only and device-free (no chip lock, like
+            # multihost_dryrun) while still exercising the REAL pool +
+            # batcher + HTTP stack. The bench exits 0 even on error, so
+            # the done marker requires a multi-replica scaling block with
+            # a p99 column, zero recompile alarms, and no error field.
+            out="$STATE/serve_scale.out"
+            timeout "$(stage_timeout 600)" env \
+                SERVE_BENCH_SYNTH_MS=4 SERVE_BENCH_REPLICAS=1,4 \
+                SERVE_BENCH_CONCURRENCY=4,16 SERVE_BENCH_DURATION_S=3 \
+                SERVE_BENCH_BUDGET_S=240 \
+                python scripts/serve_bench.py > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"metric": "serve_requests_per_sec"' "$out" \
+                    && grep -Eq '"scaling": \{"replicas": [2-9]' "$out" \
+                    && grep -q '"p99_ms"' "$out" \
+                    && grep -Eq '"recompile_alarms": 0[,}]' "$out" \
+                    && ! grep -q '"error"' "$out"
                 rc=$?
             fi ;;
         run_report)

@@ -691,6 +691,36 @@ def check_serve_conf(cfg: Config) -> None:
         "serve.requests_log must be a path string or null (null = no "
         f"sidecar), got {requests_log!r}",
     )
+    replicas = cfg.select("serve.replicas", -1)
+    _require(
+        isinstance(replicas, int) and not isinstance(replicas, bool)
+        and (replicas == -1 or replicas >= 1),
+        "serve.replicas must be -1 (one replica per local device) or a "
+        f"positive int, got {replicas!r}",
+    )
+    # mirrors parallel.compress.WEIGHT_QUANT_MODES; inlined because this
+    # module is deliberately jax-free
+    weights = cfg.select("serve.weights", "exact")
+    _require(
+        weights in ("exact", "bf16", "int8"),
+        f"serve.weights must be exact|bf16|int8, got {weights!r}",
+    )
+    corpus = cfg.select("serve.corpus")
+    _require(
+        corpus is None or isinstance(corpus, str),
+        "serve.corpus must be an (n, d) .npy/.npz path or null (null = "
+        f"no /v1/neighbors), got {corpus!r}",
+    )
+    k = cfg.select("serve.neighbors_k", 10)
+    _require(
+        isinstance(k, int) and not isinstance(k, bool) and k >= 1,
+        f"serve.neighbors_k must be an int >= 1, got {k!r}",
+    )
+    metric = cfg.select("serve.neighbors_metric", "dot")
+    _require(
+        metric in ("dot", "cosine"),
+        f"serve.neighbors_metric must be dot|cosine, got {metric!r}",
+    )
     # one of the checkpoint sources must be real
     if not s.get("checkpoint"):
         _require(

@@ -75,10 +75,18 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from simclr_tpu.parallel.mesh import axis_size
 
 GRAD_ALLREDUCE_MODES = ("exact", "bf16", "int8")
+
+# weight-storage formats for the serve tier (serve.weights): the same
+# bucketed int8 format as the gradient wire path, but quantized ONCE at
+# load time with DETERMINISTIC round-to-nearest — serving must be
+# bitwise-repeatable across calls and across replicas, so the stochastic
+# rounding that makes the gradient estimator unbiased is exactly wrong here
+WEIGHT_QUANT_MODES = ("exact", "bf16", "int8")
 
 # overlap strategy for the gradient all-reduce: "off" is the single-shot
 # fused-collective path (bitwise-identical to PR 4), "chunked" decomposes it
@@ -146,6 +154,74 @@ def validate_overlap(overlap: str, chunks: int | None = None) -> str:
                 f"got {chunks!r}"
             )
     return overlap
+
+
+def validate_weight_mode(mode: str) -> str:
+    """Reject unknown serve.weights modes with the valid set spelled out."""
+    if mode not in WEIGHT_QUANT_MODES:
+        raise ValueError(
+            f"serve.weights must be one of {WEIGHT_QUANT_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def quantize_weight_buckets(
+    flat: np.ndarray, bucket_size: int = DEFAULT_BUCKET_SIZE
+) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic bucketed int8 quantization of a flat fp32 weight vector.
+
+    The storage counterpart of :func:`_quantize`: the same bucket format
+    (``scale = amax(|bucket|) / 127``, one fp32 scale per ``bucket_size``
+    elements, all-zero buckets get scale 0) but **round-to-nearest** instead
+    of stochastic rounding — weights are quantized once at engine load, and
+    the serve tier's bitwise-repeatability contract requires the same input
+    to produce the same int8 bytes on every load and every replica. Runs on
+    the host (numpy) so load-time quantization allocates nothing on device.
+
+    Returns ``(q, scales)``: ``q`` int8 of shape ``(n_buckets, bucket_size)``
+    (tail zero-padded), ``scales`` fp32 of shape ``(n_buckets,)``.
+    """
+    flat = np.asarray(flat, np.float32).reshape(-1)
+    n_buckets = -(-flat.size // bucket_size) if flat.size else 1
+    x = np.zeros((n_buckets * bucket_size,), np.float32)
+    x[: flat.size] = flat
+    x = x.reshape(n_buckets, bucket_size)
+    scale = (np.max(np.abs(x), axis=1) / _QMAX).astype(np.float32)
+    safe = np.where(scale > 0.0, scale, 1.0)
+    q = np.clip(np.rint(x / safe[:, None]), -_QMAX, _QMAX)
+    return q.astype(np.int8), scale
+
+
+def dequantize_weight_buckets(q, scales, n_elements: int):
+    """Inverse of :func:`quantize_weight_buckets`; jnp, traceable under jit.
+
+    This is the dequantize-on-load half of the serve tier's int8 weight
+    path: it runs INSIDE the jitted forward, so HBM holds only the int8
+    buckets + fp32 scales and the fp32 weights exist transiently per call.
+    """
+    x = q.astype(jnp.float32) * scales[:, None]
+    return x.reshape(-1)[:n_elements]
+
+
+def weight_storage_bytes(
+    n_elements: int, mode: str, *, bucket_size: int = DEFAULT_BUCKET_SIZE
+) -> int:
+    """Analytic resident bytes for ``n_elements`` weights under a storage mode.
+
+    The serve-tier sibling of :func:`allreduce_wire_bytes`: exact = 4 B/elem
+    (fp32), bf16 = 2 B/elem, int8 = 1 B/elem padded to whole buckets plus
+    one fp32 scale per bucket (~3.98x under fp32 at the default bucket
+    size). Rendered per replica next to the measured gauge so the two can
+    be reconciled.
+    """
+    validate_weight_mode(mode)
+    n = int(n_elements)
+    if mode == "exact":
+        return 4 * n
+    if mode == "bf16":
+        return 2 * n
+    n_buckets = -(-n // bucket_size) if n else 1
+    return n_buckets * bucket_size + 4 * n_buckets
 
 
 def _chunk_bounds(n_elements: int, chunks: int) -> list[tuple[int, int]]:

@@ -197,6 +197,38 @@ def put_row_sharded(array, mesh: Mesh) -> jax.Array:
     )
 
 
+def serve_replica_devices(replicas: int = -1) -> list[jax.Device]:
+    """The serve tier's replica placement: the first ``replicas`` local devices.
+
+    ``-1`` (the ``serve.replicas`` default) means one replica per local
+    device — the same "absorb what the runtime has" convention as
+    :class:`MeshSpec`, so one config serves a laptop and a v4-8 slice.
+    Local (not global) devices: each serve process owns its own replicas;
+    multi-host serving is N independent processes behind a load balancer,
+    not one SPMD program.
+    """
+    devices = jax.local_devices()
+    if replicas in (-1, 0):
+        return list(devices)
+    if not 1 <= replicas <= len(devices):
+        raise ValueError(
+            f"serve.replicas={replicas} but only {len(devices)} local "
+            f"devices are available (use -1 for one replica per device)"
+        )
+    return list(devices[:replicas])
+
+
+def retrieval_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Data-axis-only mesh over the local devices, for the serve tier's
+    row-sharded embedding corpus (``serve/retrieval.py``). The corpus
+    shards over every local device regardless of ``serve.replicas`` — HBM
+    residency and replica count size independently."""
+    return create_mesh(
+        MeshSpec(data=-1, model=1),
+        devices=list(devices if devices is not None else jax.local_devices()),
+    )
+
+
 def put_tree(tree, shardings):
     """Place a host-computed pytree onto per-leaf shardings, multi-host safe.
 

@@ -6,11 +6,16 @@ Turns a trained checkpoint into a live HTTP embedding service:
     bucketed jitted forward, warmup-compiled at startup;
   * :mod:`~simclr_tpu.serve.batcher` — bounded queue, dynamic
     micro-batching, backpressure, graceful drain;
+  * :mod:`~simclr_tpu.serve.replica` — one engine per local device
+    (``serve.replicas``) behind the shared queue, with per-replica
+    warmup, compile cache, and live dispatch state;
+  * :mod:`~simclr_tpu.serve.retrieval` — row-sharded in-HBM embedding
+    corpus answering exact top-k on device (``POST /v1/neighbors``);
   * :mod:`~simclr_tpu.serve.server` — stdlib ThreadingHTTPServer JSON API
-    (``POST /v1/embed``, ``GET /healthz``, ``GET /metrics``), SIGTERM →
-    drain → exit 0;
+    (``POST /v1/embed``, ``POST /v1/neighbors``, ``GET /healthz``,
+    ``GET /metrics``), SIGTERM → drain → exit 0;
   * :mod:`~simclr_tpu.serve.metrics` — Prometheus-text counters, gauges,
-    and latency summaries.
+    and latency summaries, with ``{replica="N"}``-labeled fan-out gauges.
 
 Knobs live under the ``serve:`` group of ``conf/serve.yaml``; operational
 docs in ``docs/SERVING.md``. Imports here are lazy so touching the light
@@ -24,6 +29,8 @@ __all__ = [
     "BatcherClosedError",
     "DynamicBatcher",
     "EmbedEngine",
+    "NeighborIndex",
+    "ReplicaPool",
     "ServeMetrics",
     "run_server",
     "start_server",
@@ -34,6 +41,8 @@ _EXPORTS = {
     "BatcherClosedError": "simclr_tpu.serve.batcher",
     "DynamicBatcher": "simclr_tpu.serve.batcher",
     "EmbedEngine": "simclr_tpu.serve.engine",
+    "NeighborIndex": "simclr_tpu.serve.retrieval",
+    "ReplicaPool": "simclr_tpu.serve.replica",
     "ServeMetrics": "simclr_tpu.serve.metrics",
     "run_server": "simclr_tpu.serve.server",
     "start_server": "simclr_tpu.serve.server",

@@ -17,6 +17,16 @@ bounds the latency price the first request pays for that throughput.
 Shutdown is a graceful drain: ``close()`` stops intake, the worker answers
 everything already queued, and only then exits — no accepted request is
 ever dropped (the SIGTERM contract in ``server.py``).
+
+Replica fan-out (``pool=``): with a ``serve/replica.py`` :class:`ReplicaPool`
+the batcher runs ONE coalescing worker PER replica, all pulling from the
+same bounded queue. Work-stealing off the shared queue IS the least-loaded
+dispatch policy: a worker only takes the next request when its replica is
+free, so idle replicas pick up work first and each worker coalesces its own
+batch while the others compute. Every dispatch stamps its replica's live
+state (in-flight, batch fill, compute ms — the ``/healthz`` and labeled
+``/metrics`` feeds) and tags each answered future with ``replica_id`` (the
+``X-Served-By`` response header).
 """
 
 from __future__ import annotations
@@ -56,23 +66,29 @@ class _Pending:
 
 
 class DynamicBatcher:
-    """Bounded request queue + single dispatch worker over ``embed_fn``.
+    """Bounded request queue + dispatch worker(s) over ``embed_fn``/``pool``.
 
-    ``embed_fn(images) -> embeddings`` is called from exactly one thread
-    (the worker), with at most ``max_batch`` rows per call; per-request row
-    slices of its output resolve the corresponding futures.
+    Single-engine mode: ``embed_fn(images) -> embeddings`` is called from
+    exactly one thread (the worker), with at most ``max_batch`` rows per
+    call; per-request row slices of its output resolve the corresponding
+    futures. Pool mode (``pool=`` a :class:`~simclr_tpu.serve.replica
+    .ReplicaPool`, ``embed_fn=None``): one such worker per replica over
+    the one shared queue, each calling its own replica's ``engine.embed``.
     """
 
     def __init__(
         self,
-        embed_fn,
+        embed_fn=None,
         *,
+        pool=None,
         max_batch: int = 256,
         max_delay_ms: float = 5.0,
         queue_depth: int = 64,
         metrics=None,
         span_source=None,
     ):
+        if (embed_fn is None) == (pool is None):
+            raise ValueError("pass exactly one of embed_fn or pool")
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_delay_ms < 0:
@@ -80,20 +96,34 @@ class DynamicBatcher:
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         self._embed_fn = embed_fn
+        self.pool = pool
         # () -> iterable of (name, start, end) spans describing the LAST
         # embed_fn call (the engine's pad/device_compute breakdown); read
-        # only from the worker thread, right after each dispatch
+        # only from the worker thread, right after each dispatch. Pool mode
+        # reads each replica's own engine.last_spans instead.
         self._span_source = span_source
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.metrics = metrics
         self._q: queue.Queue[_Pending] = queue.Queue(maxsize=queue_depth)
-        self._closed = threading.Event()   # stop intake; worker drains then exits
+        self._closed = threading.Event()   # stop intake; workers drain then exit
         self._abort = threading.Event()    # stop now; queued futures fail
-        self._worker = threading.Thread(
-            target=self._run, name="serve-batcher", daemon=True
-        )
-        self._worker.start()
+        if pool is None:
+            self._workers = [
+                threading.Thread(target=self._run, name="serve-batcher", daemon=True)
+            ]
+        else:
+            self._workers = [
+                threading.Thread(
+                    target=self._run,
+                    args=(rep,),
+                    name=f"serve-batcher-r{rep.rid}",
+                    daemon=True,
+                )
+                for rep in pool.replicas
+            ]
+        for w in self._workers:
+            w.start()
         if metrics is not None:
             metrics.queue_depth.set_fn(self._q.qsize)
 
@@ -129,8 +159,8 @@ class DynamicBatcher:
             self.metrics.rows_total.inc(item.n_rows)
         return item.future
 
-    # -- consumer side (the one worker thread) -----------------------------
-    def _run(self) -> None:
+    # -- consumer side (one worker thread per replica) ---------------------
+    def _run(self, replica=None) -> None:
         carry: _Pending | None = None
         while not self._abort.is_set():
             if carry is not None:
@@ -159,14 +189,18 @@ class DynamicBatcher:
                     break
                 batch.append(nxt)
                 rows += nxt.n_rows
-            self._dispatch(batch)
-        # aborted: fail whatever never got dispatched
+            self._dispatch(batch, replica)
+        # aborted: fail whatever never got dispatched (each worker fails its
+        # own carry; the shared queue hands each worker distinct items)
         for item in ([carry] if carry is not None else []) + self._drain():
             item.future.set_exception(BatcherClosedError("batcher aborted"))
 
-    def _dispatch(self, batch: list[_Pending]) -> None:
+    def _dispatch(self, batch: list[_Pending], replica=None) -> None:
         if self.metrics is not None:
             self.metrics.batch_requests_total.inc(len(batch))
+        n_rows = sum(p.n_rows for p in batch)
+        if replica is not None:
+            replica.note_dispatch(len(batch), n_rows)
         dispatched_at = time.perf_counter()
         try:
             images = (
@@ -174,22 +208,44 @@ class DynamicBatcher:
                 if len(batch) == 1
                 else np.concatenate([p.images for p in batch])
             )
-            out = self._embed_fn(images)
+            embed_fn = self._embed_fn if replica is None else replica.engine.embed
+            out = embed_fn(images)
         except BaseException as e:  # noqa: BLE001 - relayed to every caller
             if self.metrics is not None:
                 self.metrics.failed_total.inc(len(batch))
+            if replica is not None:
+                replica.note_done(len(batch), None)
             for p in batch:
                 p.future.set_exception(e)
             return
         done = time.perf_counter()
         engine_spans = ()
-        if self._span_source is not None:
+        span_source = (
+            self._span_source
+            if replica is None
+            else (lambda: replica.engine.last_spans)
+        )
+        if span_source is not None:
             try:
-                engine_spans = tuple(self._span_source())
+                engine_spans = tuple(span_source())
             except Exception:  # never let tracing break a dispatch
                 engine_spans = ()
+        if replica is not None:
+            compute_ms = next(
+                (
+                    (end - start) * 1000.0
+                    for name, start, end in engine_spans
+                    if name == "device_compute"
+                ),
+                None,
+            )
+            replica.note_done(len(batch), compute_ms)
         offset = 0
         for p in batch:
+            if replica is not None:
+                # stamped BEFORE set_result so the handler thread always
+                # sees it when the future resolves (X-Served-By header)
+                p.future.replica_id = replica.rid
             if p.trace is not None:
                 # spans are complete before the future resolves, so the
                 # handler thread reads a finished trace
@@ -218,23 +274,25 @@ class DynamicBatcher:
         """Stop intake and shut the worker down.
 
         ``drain=True`` (the SIGTERM path): every already-queued request is
-        dispatched and answered before the worker exits. ``drain=False``:
-        the worker stops at the next poll and queued futures fail with
-        :class:`BatcherClosedError`. Returns True if the worker exited
-        within ``timeout`` (it is a daemon thread either way, so a wedged
+        dispatched and answered before the workers exit. ``drain=False``:
+        the workers stop at the next poll and queued futures fail with
+        :class:`BatcherClosedError`. Returns True if every worker exited
+        within ``timeout`` (they are daemon threads either way, so a wedged
         engine cannot hang interpreter shutdown).
         """
         self._closed.set()
         if not drain:
             self._abort.set()
-        self._worker.join(timeout=timeout)
-        alive = self._worker.is_alive()
-        if alive and drain:
+        deadline = time.perf_counter() + timeout
+        for w in self._workers:
+            w.join(timeout=max(0.0, deadline - time.perf_counter()))
+        if drain and any(w.is_alive() for w in self._workers):
             # drain overran the timeout: abort so stragglers fail fast
             # rather than dangling unanswered
             self._abort.set()
-            self._worker.join(timeout=_POLL_S * 4)
-        return not self._worker.is_alive()
+            for w in self._workers:
+                w.join(timeout=_POLL_S * 4)
+        return not any(w.is_alive() for w in self._workers)
 
     def __enter__(self):
         return self

@@ -15,13 +15,22 @@ shapes:
     ``utils.profiling.synchronize`` — a value fetch, the only reliable
     completion fence on remote-tunneled runtimes), so no live request ever
     pays a compile;
-  * the engine is deliberately single-device (the jit default device):
-    request batches are latency-bound and small, so data-parallel sharding
-    buys nothing per request — scale-out is one engine process per chip
-    behind a load balancer (capacity math in ``docs/SERVING.md``).
+  * each engine is single-device BY PLACEMENT: request batches are
+    latency-bound and small, so sharding one forward buys nothing — the
+    ``device`` argument pins the committed weight copy (and therefore every
+    bucket program) to one chip, and scale-out is one engine per local
+    device behind the shared front-end queue (``serve/replica.py``'s
+    :class:`ReplicaPool`; capacity math in ``docs/SERVING.md``);
+  * ``weights`` selects the resident storage format
+    (:data:`~simclr_tpu.parallel.compress.WEIGHT_QUANT_MODES`): ``exact``
+    keeps fp32; ``bf16`` halves it; ``int8`` stores the bucketed
+    deterministic quantization from ``parallel/compress.py`` and
+    dequantizes INSIDE the jitted forward, so per-replica HBM holds int8
+    buckets + one fp32 scale per 1024 weights (~3.98x under fp32).
 
-Thread model: ``embed`` is called only from the batcher's single worker
-thread; construction and warmup happen before the worker starts.
+Thread model: ``embed`` is called only from one batcher worker thread (its
+replica's worker under a pool); construction and warmup happen before the
+worker starts.
 """
 
 from __future__ import annotations
@@ -34,6 +43,12 @@ import numpy as np
 
 from simclr_tpu.data.augment import to_float
 from simclr_tpu.obs.compile import CompileSentry
+from simclr_tpu.parallel.compress import (
+    dequantize_weight_buckets,
+    quantize_weight_buckets,
+    validate_weight_mode,
+    weight_storage_bytes,
+)
 from simclr_tpu.utils.fetch import fetch
 from simclr_tpu.utils.profiling import synchronize
 
@@ -81,6 +96,9 @@ class EmbedEngine:
         metrics=None,
         warmup: bool = True,
         sentry=None,
+        device=None,
+        replica_id: int | None = None,
+        weights: str = "exact",
     ):
         self.model = model
         self.max_batch = int(max_batch)
@@ -88,27 +106,37 @@ class EmbedEngine:
         self.input_shape = tuple(input_shape)
         self.buckets = make_buckets(self.max_batch)
         self.metrics = metrics
+        self.device = device
+        self.replica_id = replica_id
+        self.weights_mode = validate_weight_mode(weights)
         # compile sentry (obs/compile.py): every bucket compilation is
         # recorded; a bucket compiled after warmup completes is the serve
         # tier's recompile alarm. A bare sentry (records only) is kept when
-        # the caller has no events/telemetry to wire in.
+        # the caller has no events/telemetry to wire in. Warmup gating is
+        # PER ENGINE (_warmup_done below), so under a ReplicaPool each
+        # replica's own warmup never alarms even when the pool shares one
+        # sentry — only a post-warmup cold bucket on that replica does.
         self.sentry = sentry if sentry is not None else CompileSentry()
         self._warmup_done = False
         self._warm: set[int] = set()
         # (name, start, end) perf_counter spans of the LAST embed() call
         # (pad + device_compute), read by the batcher's span_source. embed()
-        # runs only on the batcher's single worker thread (see embed()), so
-        # a plain attribute swap is safe.
+        # runs only on this engine's one batcher worker thread (see
+        # embed()), so a plain attribute swap is safe.
         self.last_spans: tuple = ()
         # one committed device copy of the variables, shared by every bucket
         # program — per-request device_put of the params would dominate the
-        # forward at small batches
-        self._params = jax.device_put(variables["params"])
-        self._batch_stats = jax.device_put(variables.get("batch_stats", {}))
+        # forward at small batches. Committing to an explicit `device` pins
+        # every bucket program there (jit follows committed arguments), so
+        # N engines over N devices run concurrently.
+        self._params, dequant, self._n_weight_elements = self._pack_params(
+            variables["params"]
+        )
+        self._batch_stats = self._put(variables.get("batch_stats", {}))
 
         def forward(params, batch_stats, images):
             x = to_float(images)
-            vs = {"params": params, "batch_stats": batch_stats}
+            vs = {"params": dequant(params), "batch_stats": batch_stats}
             if self.use_full_encoder:
                 return model.apply(vs, x, train=False).astype(jnp.float32)
             return model.apply(
@@ -122,6 +150,107 @@ class EmbedEngine:
         self._fwd = jax.jit(forward)
         if warmup:
             self.warmup()
+
+    # -- weight storage ----------------------------------------------------
+    def _put(self, tree):
+        if self.device is None:
+            return jax.device_put(tree)
+        return jax.device_put(tree, self.device)
+
+    def _pack_params(self, host_params):
+        """Device-resident param storage per ``weights`` mode.
+
+        Returns ``(packed, dequant, n_float_elements)`` where ``dequant``
+        maps the packed storage back to the forward's fp-typed param tree
+        inside the jitted program. ``int8`` quantizes the FLOAT leaves as
+        one flat vector (deterministic, ``parallel/compress.py`` bucket
+        format — same input, same bytes, every load and every replica) and
+        carries any non-float leaf exact.
+        """
+        leaves, treedef = jax.tree.flatten(host_params)
+        host = [np.asarray(l) for l in leaves]
+        is_float = [np.issubdtype(h.dtype, np.floating) for h in host]
+        n_float = int(sum(h.size for h, f in zip(host, is_float) if f))
+        # exact-carried bytes (non-float param leaves) for the analytic gauge
+        self._nonfloat_param_bytes = int(
+            sum(h.nbytes for h, f in zip(host, is_float) if not f)
+        )
+        if self.weights_mode == "exact":
+            return self._put(host_params), (lambda p: p), n_float
+        if self.weights_mode == "bf16":
+            packed = self._put(
+                jax.tree.unflatten(
+                    treedef,
+                    [
+                        h.astype(jnp.bfloat16) if f else h
+                        for h, f in zip(host, is_float)
+                    ],
+                )
+            )
+
+            def dequant_bf16(p):
+                return jax.tree.map(
+                    lambda x: x.astype(jnp.float32)
+                    if jnp.issubdtype(x.dtype, jnp.floating)
+                    else x,
+                    p,
+                )
+
+            return packed, dequant_bf16, n_float
+        flat = (
+            np.concatenate(
+                [h.reshape(-1).astype(np.float32) for h, f in zip(host, is_float) if f]
+            )
+            if n_float
+            else np.zeros((0,), np.float32)
+        )
+        q, scales = quantize_weight_buckets(flat)
+        packed = self._put(
+            {
+                "q": q,
+                "scales": scales,
+                "exact": [h for h, f in zip(host, is_float) if not f],
+            }
+        )
+        meta = [(h.shape, h.size, h.dtype) for h in host]
+
+        def dequant_int8(p):
+            vec = dequantize_weight_buckets(p["q"], p["scales"], n_float)
+            out, off, exact = [], 0, iter(p["exact"])
+            for (shape, size, dtype), f in zip(meta, is_float):
+                if f:
+                    out.append(vec[off : off + size].reshape(shape).astype(dtype))
+                    off += size
+                else:
+                    out.append(next(exact))
+            return jax.tree.unflatten(treedef, out)
+
+        return packed, dequant_int8, n_float
+
+    def weight_hbm_bytes(self) -> int:
+        """Measured resident weight bytes on this replica's device (params
+        storage + batch stats), summed from the committed arrays."""
+        return int(
+            sum(
+                l.nbytes
+                for l in jax.tree.leaves((self._params, self._batch_stats))
+            )
+        )
+
+    def weight_hbm_analytic_bytes(self) -> int:
+        """Analytic resident weight bytes under the storage mode:
+        :func:`~simclr_tpu.parallel.compress.weight_storage_bytes` over the
+        float param elements, plus the exact-carried non-float leaves and
+        batch stats. Rendered next to the measured gauge so preflight and
+        reality can be reconciled per replica."""
+        stats_bytes = int(
+            sum(l.nbytes for l in jax.tree.leaves(self._batch_stats))
+        )
+        return (
+            weight_storage_bytes(self._n_weight_elements, self.weights_mode)
+            + self._nonfloat_param_bytes
+            + stats_bytes
+        )
 
     # -- lifecycle ---------------------------------------------------------
     def warmup(self) -> dict[int, float]:
@@ -145,10 +274,21 @@ class EmbedEngine:
             times[b] = time.perf_counter() - t0
             self._warm.add(b)
             self.sentry.record_compile(
-                f"serve_bucket_{b}", seconds=times[b], warm=self._warmup_done
+                self._compile_name(b), seconds=times[b], warm=self._warmup_done
             )
         self._warmup_done = True
         return times
+
+    def _compile_name(self, bucket: int) -> str:
+        """Sentry name for a bucket compile; replica-tagged under a pool so
+        fan-out keeps per-replica compile attribution distinct."""
+        if self.replica_id is None:
+            return f"serve_bucket_{bucket}"
+        return f"serve_r{self.replica_id}_bucket_{bucket}"
+
+    def warm_state(self) -> list[int]:
+        """Buckets with a compiled program (sorted) — /healthz evidence."""
+        return sorted(self._warm)
 
     # -- request path ------------------------------------------------------
     def bucket_for(self, n_rows: int) -> int:
@@ -205,7 +345,7 @@ class EmbedEngine:
             # the compiling dispatch: its duration upper-bounds the compile.
             # warm=True (post-warmup cold bucket) raises the recompile alarm.
             self.sentry.record_compile(
-                f"serve_bucket_{bucket}",
+                self._compile_name(bucket),
                 seconds=done - t0,
                 warm=self._warmup_done,
             )
@@ -233,7 +373,16 @@ class EmbedEngine:
 
     # -- construction from a run directory ---------------------------------
     @classmethod
-    def from_checkpoint(cls, cfg, *, metrics=None, warmup: bool = True, sentry=None):
+    def from_checkpoint(
+        cls,
+        cfg,
+        *,
+        metrics=None,
+        warmup: bool = True,
+        sentry=None,
+        device=None,
+        replica_id: int | None = None,
+    ):
         """Restore the newest (or explicitly chosen) checkpoint of a run.
 
         Uses eval's blessed constructor/loader so served embeddings are the
@@ -263,6 +412,9 @@ class EmbedEngine:
             metrics=metrics,
             warmup=warmup,
             sentry=sentry,
+            device=device,
+            replica_id=replica_id,
+            weights=str(cfg.select("serve.weights", "exact")),
         )
         engine.checkpoint_path = str(ckpt)
         return engine

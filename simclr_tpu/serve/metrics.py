@@ -64,6 +64,24 @@ class ServeMetrics:
         self.client_disconnects_total = Counter(
             "simclr_serve_client_disconnects_total",
             "Responses dropped mid-write by a disconnecting client")
+        self.neighbors_requests_total = Counter(
+            "simclr_serve_neighbors_requests_total",
+            "Neighbor-search requests answered")
+        self.neighbors_queries_total = Counter(
+            "simclr_serve_neighbors_queries_total",
+            "Query rows across neighbor-search requests")
+        self.neighbors_latency_ms = Summary(
+            "simclr_serve_neighbors_latency_ms",
+            "On-device top-k latency per neighbors request (milliseconds)")
+        self.corpus_hbm_bytes = Gauge(
+            "simclr_serve_corpus_hbm_bytes",
+            "Row-sharded retrieval corpus bytes resident in device HBM")
+        # ReplicaPool for the {replica="N"}-labeled per-replica gauges;
+        # attached by start_server when serving through a pool
+        self._pool = None
+
+    def attach_pool(self, pool) -> None:
+        self._pool = pool
 
     def avg_batch_fill(self) -> float:
         """Mean requests coalesced per dispatched engine batch."""
@@ -87,6 +105,8 @@ class ServeMetrics:
                 self.queue_depth,
                 self.request_latency_ms, self.batch_latency_ms,
                 self.client_disconnects_total,
+                self.neighbors_requests_total, self.neighbors_queries_total,
+                self.neighbors_latency_ms, self.corpus_hbm_bytes,
             )
         ]
         parts.append(
@@ -99,4 +119,37 @@ class ServeMetrics:
             "# TYPE simclr_serve_batch_fill_ratio gauge\n"
             f"simclr_serve_batch_fill_ratio {self.fill_ratio():g}\n"
         )
+        if self._pool is not None:
+            parts.append(self._render_replicas())
+        return "".join(parts)
+
+    def _render_replicas(self) -> str:
+        """Per-replica gauges with a manual ``{replica="N"}`` label — the
+        same inline-label rendering Summary uses for quantiles (the
+        primitives themselves are label-free by design)."""
+        reps = self._pool.replicas
+        gauges = [
+            ("simclr_serve_replica_batch_fill",
+             "Mean requests per dispatched batch on this replica",
+             lambda r: r.batch_fill()),
+            ("simclr_serve_replica_in_flight",
+             "Requests dispatched to this replica awaiting results",
+             lambda r: r.in_flight),
+            ("simclr_serve_replica_compute_ms",
+             "Device compute milliseconds of this replica's last batch",
+             lambda r: r.compute_ms()),
+            ("simclr_serve_replica_weight_hbm_bytes",
+             "Measured resident weight bytes on this replica's device",
+             lambda r: r.engine.weight_hbm_bytes()
+             if hasattr(r.engine, "weight_hbm_bytes") else 0),
+            ("simclr_serve_replica_weight_hbm_analytic_bytes",
+             "Analytic weight bytes under the serve.weights storage mode",
+             lambda r: r.engine.weight_hbm_analytic_bytes()
+             if hasattr(r.engine, "weight_hbm_analytic_bytes") else 0),
+        ]
+        parts = []
+        for name, help_text, read in gauges:
+            parts.append(f"# HELP {name} {help_text}\n# TYPE {name} gauge\n")
+            for rep in reps:
+                parts.append(f'{name}{{replica="{rep.rid}"}} {read(rep):g}\n')
         return "".join(parts)

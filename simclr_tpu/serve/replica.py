@@ -1,0 +1,217 @@
+"""Replica pool: one :class:`EmbedEngine` per local device, shared queue.
+
+The serve tier's scale-out unit. A single engine serializes every forward
+on one chip; a :class:`ReplicaPool` builds N engines over the first N
+local devices (``serve.replicas``, -1 = all — ``mesh.serve_replica_devices``),
+each with its OWN committed weight copy, bucket jit cache, warmup pass,
+and ``_warmup_done`` sentry gate, so aggregate throughput scales with
+device count while each request still runs the identical single-device
+program (exact weights => responses bitwise identical to the
+single-replica path, pinned by test).
+
+Dispatch model (least-loaded by construction): the pool does not route —
+``DynamicBatcher`` runs one coalescing worker PER replica, all pulling
+from the one shared bounded queue. A worker only takes work when its
+replica is free, so the next request always lands on a least-loaded
+(idle-first) replica, and each worker coalesces its own batch while the
+other replicas compute. Per-replica load/batch/compute state lives here
+(:class:`ReplicaState`) and feeds ``/healthz`` and the ``replica``-labeled
+``/metrics`` gauges (``serve/metrics.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ReplicaState:
+    """One replica's engine plus its live dispatch bookkeeping.
+
+    Mutated only by that replica's single batcher worker (note_* calls) and
+    read by /healthz and /metrics render threads — hence the lock around
+    the multi-field snapshot.
+    """
+
+    def __init__(self, rid: int, engine):
+        self.rid = int(rid)
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._in_flight = 0       # requests dispatched to the engine, unresolved
+        self._batches = 0
+        self._batch_requests = 0
+        self._rows = 0
+        self._last_dispatch_unix: float | None = None
+        self._last_compute_ms: float | None = None
+
+    # -- worker-side bookkeeping -------------------------------------------
+    def note_dispatch(self, n_requests: int, n_rows: int) -> None:
+        with self._lock:
+            self._in_flight += n_requests
+            self._batches += 1
+            self._batch_requests += n_requests
+            self._rows += n_rows
+            self._last_dispatch_unix = time.time()
+
+    def note_done(self, n_requests: int, compute_ms: float | None) -> None:
+        with self._lock:
+            self._in_flight = max(0, self._in_flight - n_requests)
+            if compute_ms is not None:
+                self._last_compute_ms = compute_ms
+
+    # -- observability reads ------------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    @property
+    def batches(self) -> int:
+        with self._lock:
+            return self._batches
+
+    def batch_fill(self) -> float:
+        """Mean requests coalesced per batch ON THIS replica."""
+        with self._lock:
+            return self._batch_requests / self._batches if self._batches else 0.0
+
+    def compute_ms(self) -> float:
+        with self._lock:
+            return self._last_compute_ms if self._last_compute_ms is not None else 0.0
+
+    def state(self) -> dict:
+        """The /healthz per-replica entry."""
+        with self._lock:
+            last = self._last_dispatch_unix
+            snapshot = {
+                "replica": self.rid,
+                "device": str(getattr(self.engine, "device", None)),
+                "warmed_buckets": list(self.engine.warm_state())
+                if hasattr(self.engine, "warm_state")
+                else [],
+                "in_flight": self._in_flight,
+                "batches": self._batches,
+                "rows": self._rows,
+                "last_dispatch_unix": last,
+                "weights": getattr(self.engine, "weights_mode", "exact"),
+            }
+        return snapshot
+
+
+class ReplicaPool:
+    """N engines over N devices behind one front-end queue.
+
+    Construction does NOT start any worker — ``DynamicBatcher(pool=...)``
+    owns the threads. The pool is the engine registry plus per-replica
+    state; ``primary`` keeps the single-engine surface (buckets, max_batch,
+    feature_dim, checkpoint_path) the HTTP layer already speaks.
+    """
+
+    def __init__(self, engines):
+        if not engines:
+            raise ValueError("ReplicaPool needs at least one engine")
+        # engines keep whatever replica_id they were built with (None for a
+        # wrapped legacy single engine — its sentry names stay untagged)
+        self.replicas = [ReplicaState(i, e) for i, e in enumerate(engines)]
+
+    # -- single-engine-compatible surface ----------------------------------
+    @property
+    def primary(self):
+        return self.replicas[0].engine
+
+    @property
+    def size(self) -> int:
+        return len(self.replicas)
+
+    def warmup(self) -> dict[int, dict[int, float]]:
+        """Warm every replica's bucket cache; per-replica per-bucket seconds."""
+        return {rep.rid: rep.engine.warmup() for rep in self.replicas}
+
+    def state(self) -> list[dict]:
+        return [rep.state() for rep in self.replicas]
+
+    def weight_hbm_bytes(self) -> dict[int, int]:
+        return {
+            rep.rid: rep.engine.weight_hbm_bytes()
+            for rep in self.replicas
+            if hasattr(rep.engine, "weight_hbm_bytes")
+        }
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_model(
+        cls,
+        model,
+        variables: dict,
+        *,
+        replicas: int = -1,
+        max_batch: int = 256,
+        use_full_encoder: bool = False,
+        input_shape: tuple[int, ...] = (32, 32, 3),
+        metrics=None,
+        warmup: bool = True,
+        sentry=None,
+        weights: str = "exact",
+    ):
+        """One engine per device from one host copy of the variables.
+
+        The host pytree is shared; each engine commits its own (possibly
+        quantized) device copy, so N replicas cost N weight residencies —
+        the HBM number ``serve.weights`` exists to shrink.
+        """
+        from simclr_tpu.parallel.mesh import serve_replica_devices
+        from simclr_tpu.serve.engine import EmbedEngine
+
+        engines = [
+            EmbedEngine(
+                model,
+                variables,
+                max_batch=max_batch,
+                use_full_encoder=use_full_encoder,
+                input_shape=input_shape,
+                metrics=metrics,
+                warmup=warmup,
+                sentry=sentry,
+                device=device,
+                replica_id=rid,
+                weights=weights,
+            )
+            for rid, device in enumerate(serve_replica_devices(int(replicas)))
+        ]
+        return cls(engines)
+
+    @classmethod
+    def from_checkpoint(cls, cfg, *, metrics=None, warmup: bool = True, sentry=None):
+        """Restore the checkpoint ONCE, then fan the host variables out to
+        one engine per ``serve.replicas`` device (the pool counterpart of
+        ``EmbedEngine.from_checkpoint`` — same blessed loaders, same
+        sha256-verified restore path)."""
+        from simclr_tpu.eval import build_eval_model, load_model_variables
+        from simclr_tpu.utils.checkpoint import latest_checkpoint
+
+        ckpt = cfg.select("serve.checkpoint")
+        if not ckpt:
+            target_dir = str(cfg.experiment.target_dir)
+            ckpt = latest_checkpoint(target_dir)
+            if ckpt is None:
+                raise FileNotFoundError(
+                    f"no checkpoints found under {target_dir!r}; set "
+                    f"experiment.target_dir or serve.checkpoint"
+                )
+        model = build_eval_model(cfg)
+        variables = load_model_variables(str(ckpt))
+        pool = cls.from_model(
+            model,
+            variables,
+            replicas=int(cfg.select("serve.replicas", -1)),
+            max_batch=int(cfg.serve.max_batch),
+            use_full_encoder=bool(cfg.parameter.use_full_encoder),
+            metrics=metrics,
+            warmup=warmup,
+            sentry=sentry,
+            weights=str(cfg.select("serve.weights", "exact")),
+        )
+        pool.checkpoint_path = str(ckpt)
+        for rep in pool.replicas:
+            rep.engine.checkpoint_path = str(ckpt)
+        return pool

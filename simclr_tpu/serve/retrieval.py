@@ -1,0 +1,232 @@
+"""On-device exact top-k retrieval over a row-sharded embedding corpus.
+
+The similarity-search half of the serve tier (``POST /v1/neighbors``). The
+corpus — an ``(n, d)`` float32 embedding matrix, typically produced by
+``eval.save_features`` — is uploaded ONCE through the training stack's
+``parallel.mesh.put_row_sharded`` onto a data-axis-only mesh over every
+local device, so per-chip HBM holds ``~n/S`` rows and the corpus can grow
+with the slice. Queries are answered entirely on device:
+
+  * each shard computes its local score block ``q @ shard.T`` (B x R) and
+    keeps only its local ``top_k`` — the full B x n similarity matrix is
+    never materialized anywhere, host or device;
+  * the ``min(k, R)`` local winners per shard (scores + GLOBAL row ids,
+    padding rows masked to -inf) are ``all_gather``ed and merged with one
+    final ``top_k`` over the ``S * min(k, R)`` candidates. ``min(k, R)``
+    per shard is sufficient for exactness: no shard can place more than
+    ``R`` rows in the global top-k.
+  * the merge is **oracle-exact including ties**: XLA's TopK is stable
+    (equal scores -> lowest index first), and candidates are laid out
+    shard-major, so the global tie-break is lowest global row id — exactly
+    ``np.argsort(-scores, kind="stable")`` (pinned by test).
+
+Query batches are padded to the same power-of-two buckets the embed path
+uses (one compiled program per (k, bucket), warmed lazily); compiles are
+recorded to the CompileSentry with ``warm=False`` so a novel ``k`` never
+trips the serve recompile alarm, which guards the *embed* warmup contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from simclr_tpu.parallel.mesh import (
+    DATA_AXIS,
+    put_row_sharded,
+    retrieval_mesh,
+    shard_map,
+)
+from simclr_tpu.serve.engine import make_buckets
+from simclr_tpu.utils.fetch import fetch
+
+METRICS = ("dot", "cosine")
+
+
+def _normalize_rows(x: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(x, axis=1, keepdims=True)
+    return x / np.where(norms > 0.0, norms, 1.0)
+
+
+class NeighborIndex:
+    """Row-sharded corpus + per-(k, bucket) compiled exact top-k programs.
+
+    ``metric="cosine"`` L2-normalizes corpus rows at upload and queries at
+    request time, reducing cosine similarity to the same dot-product
+    kernel. Thread model: ``query`` may be called from any handler thread;
+    a lock serializes program build + compile bookkeeping (the matmul
+    itself is serialized by jax's dispatch anyway).
+    """
+
+    def __init__(
+        self,
+        corpus,
+        *,
+        metric: str = "dot",
+        max_queries: int = 256,
+        mesh=None,
+        sentry=None,
+        metrics=None,
+    ):
+        if metric not in METRICS:
+            raise ValueError(f"neighbors metric must be one of {METRICS}, got {metric!r}")
+        host = np.asarray(corpus, np.float32)
+        if host.ndim != 2 or host.shape[0] < 1:
+            raise ValueError(f"corpus must be (n >= 1, d), got {host.shape}")
+        self.metric = metric
+        self.n, self.d = host.shape
+        if metric == "cosine":
+            host = _normalize_rows(host)
+        self.mesh = mesh if mesh is not None else retrieval_mesh()
+        self.n_shards = self.mesh.shape[DATA_AXIS]
+        # device-resident, row-sharded over the data axis; the padded tail
+        # (put_row_sharded zero-fills to equal shards) is masked to -inf in
+        # the kernel so it can never win a top-k slot
+        self.corpus = put_row_sharded(host, self.mesh)
+        self.rows_per_shard = self.corpus.shape[0] // self.n_shards
+        self.max_queries = int(max_queries)
+        self.buckets = make_buckets(self.max_queries)
+        self.sentry = sentry
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._fns: dict[int, object] = {}
+        self._compiled: set[tuple[int, int]] = set()
+        if metrics is not None and hasattr(metrics, "corpus_hbm_bytes"):
+            metrics.corpus_hbm_bytes.set(int(self.corpus.nbytes))
+
+    @classmethod
+    def from_file(cls, path: str, **kwargs):
+        """Load an ``(n, d)`` corpus from ``.npy`` or ``.npz`` (first array,
+        or the ``features`` key — ``eval.save_features`` layout)."""
+        path = str(path)
+        if path.endswith(".npz"):
+            with np.load(path) as z:
+                key = "features" if "features" in z.files else z.files[0]
+                arr = z[key]
+        else:
+            arr = np.load(path)
+        return cls(arr, **kwargs)
+
+    # -- program construction ----------------------------------------------
+    def _fn_for(self, k: int):
+        """The jitted shard_map top-k program for one ``k`` (shape-keyed jit
+        cache handles the query buckets)."""
+        fn = self._fns.get(k)
+        if fn is not None:
+            return fn
+        n, r, kk = self.n, self.rows_per_shard, min(k, self.rows_per_shard)
+
+        def local_merge(q, shard):
+            # q: (B, d) replicated; shard: (R, d) this shard's row block
+            scores = q @ shard.T  # (B, R) — the only similarity block ever built
+            sidx = jax.lax.axis_index(DATA_AXIS)
+            global_idx = sidx * r + jnp.arange(r, dtype=jnp.int32)
+            scores = jnp.where(global_idx[None, :] < n, scores, -jnp.inf)
+            vals, idx = jax.lax.top_k(scores, kk)
+            gidx = jnp.take(global_idx, idx)
+            # (S, B, kk) -> shard-major (B, S*kk) candidate lists: stable
+            # TopK over this layout tie-breaks to the lowest global row id
+            vals_all = jax.lax.all_gather(vals, DATA_AXIS)
+            gidx_all = jax.lax.all_gather(gidx, DATA_AXIS)
+            cand_vals = jnp.moveaxis(vals_all, 0, 1).reshape(q.shape[0], -1)
+            cand_idx = jnp.moveaxis(gidx_all, 0, 1).reshape(q.shape[0], -1)
+            top_vals, pos = jax.lax.top_k(cand_vals, k)
+            top_idx = jnp.take_along_axis(cand_idx, pos, axis=1)
+            return top_vals, top_idx
+
+        fn = jax.jit(
+            shard_map(
+                local_merge,
+                mesh=self.mesh,
+                in_specs=(P(), P(DATA_AXIS)),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        self._fns[k] = fn
+        return fn
+
+    def bucket_for(self, n_queries: int) -> int:
+        if n_queries < 1:
+            raise ValueError(f"need at least one query, got {n_queries}")
+        if n_queries > self.max_queries:
+            raise ValueError(
+                f"{n_queries} queries exceeds the {self.max_queries}-query "
+                f"ceiling; split the request"
+            )
+        for b in self.buckets:
+            if b >= n_queries:
+                return b
+        raise AssertionError("unreachable: buckets end at max_queries")
+
+    def warmup(self, k: int) -> None:
+        """Pre-compile every query bucket for one ``k`` (served cold
+        otherwise — neighbors compiles never alarm)."""
+        for b in self.buckets:
+            self._query_padded(np.zeros((b, self.d), np.float32), k, b)
+
+    # -- request path ------------------------------------------------------
+    def query(self, queries, k: int) -> tuple[np.ndarray, np.ndarray]:
+        """Exact top-``k`` over the corpus; ``(B, k)`` scores + row indices.
+
+        ``queries``: ``(B, d)`` float rows. ``k`` must fit the corpus
+        (``1 <= k <= n``) so every returned slot is a real row.
+        """
+        q = np.asarray(queries, np.float32)
+        if q.ndim != 2 or q.shape[1] != self.d:
+            raise ValueError(f"queries must be (B, {self.d}), got {q.shape}")
+        if not 1 <= int(k) <= self.n:
+            raise ValueError(f"k must be in [1, {self.n}] for a {self.n}-row corpus, got {k}")
+        k = int(k)
+        b = q.shape[0]
+        bucket = self.bucket_for(b)
+        if self.metric == "cosine":
+            q = _normalize_rows(q)
+        if b < bucket:
+            q = np.concatenate([q, np.zeros((bucket - b, self.d), np.float32)])
+        t0 = time.perf_counter()
+        vals, idx = self._query_padded(q, k, bucket)
+        if self.metrics is not None:
+            self.metrics.neighbors_requests_total.inc()
+            self.metrics.neighbors_queries_total.inc(b)
+            self.metrics.neighbors_latency_ms.observe(
+                (time.perf_counter() - t0) * 1000.0
+            )
+        return np.asarray(vals[:b]), np.asarray(idx[:b], np.int64)
+
+    def _query_padded(self, q: np.ndarray, k: int, bucket: int):
+        with self._lock:
+            fn = self._fn_for(k)
+            cold = (k, bucket) not in self._compiled
+            if cold:
+                self._compiled.add((k, bucket))
+        t0 = time.perf_counter()
+        out_vals, out_idx = fn(q, self.corpus)
+        vals, idx = fetch(out_vals), fetch(out_idx)
+        if cold and self.sentry is not None:
+            # warm=False by design: novel (k, bucket) programs are an
+            # expected lazy compile, not a broken embed warmup
+            self.sentry.record_compile(
+                f"neighbors_k{k}_q{bucket}",
+                seconds=time.perf_counter() - t0,
+                warm=False,
+            )
+        return vals, idx
+
+    # -- observability ------------------------------------------------------
+    def hbm_state(self) -> dict:
+        """The /healthz ``neighbors`` entry: corpus residency + programs."""
+        return {
+            "rows": self.n,
+            "dim": self.d,
+            "metric": self.metric,
+            "shards": self.n_shards,
+            "rows_per_shard": self.rows_per_shard,
+            "corpus_hbm_bytes": int(self.corpus.nbytes),
+            "compiled_programs": sorted(self._compiled),
+        }

@@ -5,10 +5,20 @@ Endpoints:
   * ``POST /v1/embed`` — body ``{"instances": [image, ...]}`` where each
     image is a nested list of uint8 pixels shaped like the engine's input
     (CIFAR: 32x32x3). Response ``{"embeddings": [[...], ...], "model": ...}``
-    row-aligned with the instances. Errors: 400 malformed body/shape/range,
-    413 more rows than ``serve.max_batch``, 429 queue full (backpressure —
-    retry with backoff), 500 engine failure, 503 draining.
-  * ``GET /healthz`` — 200 once warm and accepting, 503 while draining.
+    row-aligned with the instances, with an ``X-Served-By: <replica>``
+    header naming the replica that computed it. Errors: 400 malformed
+    body/shape/range, 413 more rows than ``serve.max_batch``, 429 queue
+    full (backpressure — retry with backoff), 500 engine failure, 503
+    draining.
+  * ``POST /v1/neighbors`` — body ``{"queries": [[d floats], ...],
+    "k": int}`` (``k`` optional, default ``serve.neighbors_k``). Exact
+    top-k over the row-sharded in-HBM corpus (``serve.corpus``,
+    ``serve/retrieval.py``); response ``{"indices": [[...]], "scores":
+    [[...]]}`` row-aligned with the queries. 404 when no corpus is
+    configured, 400 malformed queries/k, 503 draining.
+  * ``GET /healthz`` — 200 once warm and accepting (with per-replica
+    state under ``"replicas"`` and corpus residency under ``"neighbors"``),
+    503 while draining.
   * ``GET /metrics`` — Prometheus text format (``serve/metrics.py``).
   * ``GET /debug/slow`` — the slowest recent requests with their span
     breakdowns (``obs/trace.py`` ring buffer).
@@ -68,11 +78,17 @@ class EmbedServer(ThreadingHTTPServer):
         metrics,
         request_timeout_s=30.0,
         recorder: TraceRecorder | None = None,
+        pool=None,
+        index=None,
+        neighbors_k_default=10,
     ):
         super().__init__(address, EmbedHandler)
         self.engine = engine
         self.batcher = batcher
         self.metrics = metrics
+        self.pool = pool          # serve/replica.py ReplicaPool (healthz fan-out)
+        self.index = index        # serve/retrieval.py NeighborIndex, or None
+        self.neighbors_k_default = int(neighbors_k_default)
         self.request_timeout_s = float(request_timeout_s)
         self.recorder = recorder if recorder is not None else TraceRecorder()
         self.draining = threading.Event()
@@ -124,18 +140,20 @@ class EmbedHandler(BaseHTTPRequestHandler):
             if self.server.draining.is_set():
                 self._send_json(503, {"status": "draining"})
             else:
-                self._send_json(
-                    200,
-                    {
-                        "status": "ok",
-                        "buckets": list(self.server.engine.buckets),
-                        "max_batch": self.server.engine.max_batch,
-                        "feature_dim": self.server.engine.feature_dim,
-                        "checkpoint": getattr(
-                            self.server.engine, "checkpoint_path", None
-                        ),
-                    },
-                )
+                payload = {
+                    "status": "ok",
+                    "buckets": list(self.server.engine.buckets),
+                    "max_batch": self.server.engine.max_batch,
+                    "feature_dim": self.server.engine.feature_dim,
+                    "checkpoint": getattr(
+                        self.server.engine, "checkpoint_path", None
+                    ),
+                }
+                if self.server.pool is not None:
+                    payload["replicas"] = self.server.pool.state()
+                if self.server.index is not None:
+                    payload["neighbors"] = self.server.index.hbm_state()
+                self._send_json(200, payload)
         elif self.path == "/metrics":
             self._send(
                 200,
@@ -152,6 +170,9 @@ class EmbedHandler(BaseHTTPRequestHandler):
         # resolved first so EVERY response (including errors) echoes the id
         rid = clean_request_id(self.headers.get("X-Request-Id"))
         self._request_id = rid
+        if self.path == "/v1/neighbors":
+            self._post_neighbors(rid)
+            return
         if self.path != "/v1/embed":
             self._send_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -207,7 +228,85 @@ class EmbedHandler(BaseHTTPRequestHandler):
         logger.debug(
             "embed %s: %d rows in %.1f ms", rid, len(embeddings), rec["total_ms"]
         )
-        self._send(200, body, "application/json")
+        # stamped by the dispatching replica's worker before the future
+        # resolved (pool mode); absent on the legacy single-engine path
+        served_by = getattr(future, "replica_id", None)
+        headers = (
+            [("X-Served-By", str(served_by))] if served_by is not None else []
+        )
+        self._send(200, body, "application/json", headers)
+
+    def _post_neighbors(self, rid) -> None:
+        index = self.server.index
+        if index is None:
+            self._send_json(
+                404,
+                {"error": "no retrieval corpus configured (set serve.corpus)"},
+            )
+            return
+        if self.server.draining.is_set():
+            self._send_json(
+                503, {"error": "server is draining"}, [("Retry-After", "1")]
+            )
+            return
+        try:
+            queries, k = self._parse_neighbors(index)
+        except _BadRequest as e:
+            logger.debug("neighbors %s rejected (%d): %s", rid, e.code, e)
+            self._send_json(e.code, {"error": str(e)})
+            return
+        try:
+            scores, indices = index.query(queries, k)
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:  # device failure
+            logger.warning("neighbors %s failed: %r", rid, e)
+            self._send_json(500, {"error": repr(e)})
+            return
+        self._send_json(
+            200,
+            {
+                "indices": indices.tolist(),
+                "scores": scores.tolist(),
+                "k": k,
+                "metric": index.metric,
+            },
+        )
+
+    def _parse_neighbors(self, index) -> tuple:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise _BadRequest("missing request body")
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise _BadRequest(f"body is not valid JSON: {e}") from None
+        if not isinstance(payload, dict) or "queries" not in payload:
+            raise _BadRequest('body must be a JSON object with "queries"')
+        try:
+            queries = np.asarray(payload["queries"], np.float32)
+        except (ValueError, TypeError) as e:
+            raise _BadRequest(f"queries are not a rectangular float array: {e}") from None
+        if queries.ndim != 2 or queries.shape[1] != index.d:
+            raise _BadRequest(
+                f"queries must be shaped (n, {index.d}), got {queries.shape}"
+            )
+        if not 1 <= queries.shape[0] <= index.max_queries:
+            raise _BadRequest(
+                f"queries must carry 1..{index.max_queries} rows, "
+                f"got {queries.shape[0]}"
+            )
+        if not np.isfinite(queries).all():
+            raise _BadRequest("queries must be finite floats")
+        k = payload.get("k", self.server.neighbors_k_default)
+        if not isinstance(k, int) or isinstance(k, bool):
+            raise _BadRequest(f"k must be an integer, got {k!r}")
+        if not 1 <= k <= index.n:
+            raise _BadRequest(
+                f"k must be in [1, {index.n}] for a {index.n}-row corpus, got {k}"
+            )
+        return queries, k
 
     def _parse_instances(self) -> np.ndarray:
         length = int(self.headers.get("Content-Length") or 0)
@@ -260,22 +359,21 @@ def run_server(cfg) -> int:
     the signal wiring the test process cannot own).
     """
     from simclr_tpu.config import check_serve_conf
-    from simclr_tpu.serve.engine import EmbedEngine
     from simclr_tpu.serve.metrics import ServeMetrics
+    from simclr_tpu.serve.replica import ReplicaPool
 
     check_serve_conf(cfg)
     metrics = ServeMetrics()
-    logger.info("restoring checkpoint and warming buckets...")
-    engine = EmbedEngine.from_checkpoint(cfg, metrics=metrics, warmup=False)
-    warm_times = engine.warmup()
-    logger.info(
-        "warmed %d bucket programs (max_batch=%d): %s",
-        len(warm_times), engine.max_batch,
-        " ".join(f"b{b}={t:.2f}s" for b, t in sorted(warm_times.items())),
-    )
-    server, _batcher = start_server(
-        cfg, engine=engine, metrics=metrics
-    )
+    logger.info("restoring checkpoint and building replicas...")
+    pool = ReplicaPool.from_checkpoint(cfg, metrics=metrics, warmup=False)
+    warm_times = pool.warmup()
+    for rid, times in sorted(warm_times.items()):
+        logger.info(
+            "replica %d: warmed %d bucket programs (max_batch=%d): %s",
+            rid, len(times), pool.primary.max_batch,
+            " ".join(f"b{b}={t:.2f}s" for b, t in sorted(times.items())),
+        )
+    server, _batcher = start_server(cfg, pool=pool, metrics=metrics)
 
     def _terminate(signum, frame):
         # shutdown() must not run on the serve_forever thread (it blocks on
@@ -305,26 +403,55 @@ def run_server(cfg) -> int:
     return 0
 
 
-def start_server(cfg, *, engine=None, metrics=None) -> tuple:
+def start_server(cfg, *, engine=None, metrics=None, pool=None, index=None) -> tuple:
     """Construct (EmbedServer, DynamicBatcher) bound to ``serve.host:port``
     without entering the accept loop — the embeddable/testable core of
     :func:`run_server`. Caller runs ``serve_forever`` and later
-    :func:`shutdown_gracefully`."""
+    :func:`shutdown_gracefully`.
+
+    ``pool`` (a :class:`~simclr_tpu.serve.replica.ReplicaPool`) is the
+    replicated path; a bare ``engine`` is wrapped into a pool of one, so
+    every server runs the same per-replica worker machinery. ``index``
+    (a :class:`~simclr_tpu.serve.retrieval.NeighborIndex`) enables
+    ``/v1/neighbors``; when None it is built from ``serve.corpus`` if set.
+    """
     from simclr_tpu.serve.batcher import DynamicBatcher
-    from simclr_tpu.serve.engine import EmbedEngine
     from simclr_tpu.serve.metrics import ServeMetrics
+    from simclr_tpu.serve.replica import ReplicaPool
 
     metrics = metrics if metrics is not None else ServeMetrics()
-    if engine is None:
-        engine = EmbedEngine.from_checkpoint(cfg, metrics=metrics)
+    if pool is None:
+        if engine is not None:
+            pool = ReplicaPool([engine])
+        else:
+            pool = ReplicaPool.from_checkpoint(cfg, metrics=metrics)
+    metrics.attach_pool(pool)
+    primary = pool.primary
     batcher = DynamicBatcher(
-        engine.embed,
-        max_batch=engine.max_batch,
+        pool=pool,
+        max_batch=primary.max_batch,
         max_delay_ms=float(cfg.serve.max_delay_ms),
         queue_depth=int(cfg.serve.queue_depth),
         metrics=metrics,
-        span_source=lambda: getattr(engine, "last_spans", ()),
     )
+    if index is None:
+        corpus = cfg.select("serve.corpus")
+        if corpus:
+            from simclr_tpu.serve.retrieval import NeighborIndex
+
+            index = NeighborIndex.from_file(
+                str(corpus),
+                metric=str(cfg.select("serve.neighbors_metric", "dot")),
+                max_queries=primary.max_batch,
+                sentry=primary.sentry,
+                metrics=metrics,
+            )
+            logger.info(
+                "retrieval corpus resident: %d rows x %d dims over %d shards "
+                "(%.1f MiB HBM)",
+                index.n, index.d, index.n_shards,
+                index.corpus.nbytes / 2**20,
+            )
     requests_log = cfg.select("serve.requests_log")
     recorder = TraceRecorder(
         sample_rate=float(cfg.select("serve.trace_sample_rate", 0.0) or 0.0),
@@ -332,11 +459,14 @@ def start_server(cfg, *, engine=None, metrics=None) -> tuple:
     )
     server = EmbedServer(
         (str(cfg.serve.host), int(cfg.serve.port)),
-        engine,
+        primary,
         batcher,
         metrics,
         request_timeout_s=float(cfg.serve.request_timeout_s),
         recorder=recorder,
+        pool=pool,
+        index=index,
+        neighbors_k_default=int(cfg.select("serve.neighbors_k", 10)),
     )
     return server, batcher
 

@@ -23,8 +23,13 @@ from simclr_tpu.parallel import compress
 from simclr_tpu.parallel.compress import (
     DEFAULT_BUCKET_SIZE,
     GRAD_ALLREDUCE_MODES,
+    WEIGHT_QUANT_MODES,
     allreduce_wire_bytes,
+    dequantize_weight_buckets,
     grad_allreduce,
+    quantize_weight_buckets,
+    validate_weight_mode,
+    weight_storage_bytes,
 )
 from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -585,3 +590,53 @@ def test_tp_chunked_ring_matches_off():
 
 def test_modes_registry():
     assert GRAD_ALLREDUCE_MODES == ("exact", "bf16", "int8")
+
+
+class TestWeightQuantizer:
+    """The serve-tier weight storage path (quantize once at engine load,
+    dequantize inside the jitted forward). Distinct from the gradient
+    quantizer above: round-to-nearest, not stochastic — determinism is the
+    bitwise-repeatability contract across loads and replicas."""
+
+    def test_roundtrip_error_bounded_by_half_scale(self):
+        rng = np.random.default_rng(0)
+        flat = rng.normal(size=4096 + 100).astype(np.float32)  # ragged tail
+        q, scales = quantize_weight_buckets(flat)
+        assert q.dtype == np.int8 and q.shape == (5, DEFAULT_BUCKET_SIZE)
+        assert scales.dtype == np.float32 and scales.shape == (5,)
+        back = np.asarray(dequantize_weight_buckets(q, scales, flat.size))
+        per_bucket_bound = np.repeat(scales / 2, DEFAULT_BUCKET_SIZE)[: flat.size]
+        assert np.all(np.abs(back - flat) <= per_bucket_bound + 1e-7)
+
+    def test_deterministic_same_bytes_every_call(self):
+        flat = np.random.default_rng(1).normal(size=3000).astype(np.float32)
+        q1, s1 = quantize_weight_buckets(flat)
+        q2, s2 = quantize_weight_buckets(flat.copy())
+        np.testing.assert_array_equal(q1, q2)
+        np.testing.assert_array_equal(s1, s2)
+
+    def test_zero_and_empty_buckets(self):
+        q, s = quantize_weight_buckets(np.zeros((10,), np.float32))
+        assert np.all(q == 0) and np.all(s == 0.0)
+        back = np.asarray(dequantize_weight_buckets(q, s, 10))
+        np.testing.assert_array_equal(back, np.zeros(10, np.float32))
+        q, s = quantize_weight_buckets(np.zeros((0,), np.float32))
+        assert q.shape == (1, DEFAULT_BUCKET_SIZE)
+
+    def test_storage_bytes_analytic_model(self):
+        n = 5000
+        assert weight_storage_bytes(n, "exact") == 4 * n
+        assert weight_storage_bytes(n, "bf16") == 2 * n
+        n_buckets = -(-n // DEFAULT_BUCKET_SIZE)
+        assert weight_storage_bytes(n, "int8") == (
+            n_buckets * DEFAULT_BUCKET_SIZE + 4 * n_buckets
+        )
+        # the headline: int8 resident weights ~3.98x under fp32
+        assert weight_storage_bytes(n, "exact") / weight_storage_bytes(n, "int8") > 3.8
+
+    def test_validate_weight_mode(self):
+        assert WEIGHT_QUANT_MODES == ("exact", "bf16", "int8")
+        for mode in WEIGHT_QUANT_MODES:
+            assert validate_weight_mode(mode) == mode
+        with pytest.raises(ValueError, match="serve.weights"):
+            validate_weight_mode("fp8")

@@ -114,6 +114,22 @@ simclr_serve_batch_latency_ms_count 1
 # HELP simclr_serve_client_disconnects_total Responses dropped mid-write by a disconnecting client
 # TYPE simclr_serve_client_disconnects_total counter
 simclr_serve_client_disconnects_total 0
+# HELP simclr_serve_neighbors_requests_total Neighbor-search requests answered
+# TYPE simclr_serve_neighbors_requests_total counter
+simclr_serve_neighbors_requests_total 2
+# HELP simclr_serve_neighbors_queries_total Query rows across neighbor-search requests
+# TYPE simclr_serve_neighbors_queries_total counter
+simclr_serve_neighbors_queries_total 5
+# HELP simclr_serve_neighbors_latency_ms On-device top-k latency per neighbors request (milliseconds)
+# TYPE simclr_serve_neighbors_latency_ms summary
+simclr_serve_neighbors_latency_ms{quantile="0.5"} 3.5
+simclr_serve_neighbors_latency_ms{quantile="0.95"} 3.5
+simclr_serve_neighbors_latency_ms{quantile="0.99"} 3.5
+simclr_serve_neighbors_latency_ms_sum 3.5
+simclr_serve_neighbors_latency_ms_count 1
+# HELP simclr_serve_corpus_hbm_bytes Row-sharded retrieval corpus bytes resident in device HBM
+# TYPE simclr_serve_corpus_hbm_bytes gauge
+simclr_serve_corpus_hbm_bytes 0
 # HELP simclr_serve_avg_batch_fill Mean requests per dispatched batch
 # TYPE simclr_serve_avg_batch_fill gauge
 simclr_serve_avg_batch_fill 2.5
@@ -137,6 +153,9 @@ def _feed_serve_metrics(m):
     for v in (1.5, 2.5, 10.0):
         m.request_latency_ms.observe(v)
     m.batch_latency_ms.observe(4.25)
+    m.neighbors_requests_total.inc(2)
+    m.neighbors_queries_total.inc(5)
+    m.neighbors_latency_ms.observe(3.5)
 
 
 class TestServeShim:

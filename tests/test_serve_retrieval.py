@@ -1,0 +1,257 @@
+"""On-device exact top-k retrieval (serve/retrieval.py + /v1/neighbors).
+
+The acceptance claim is *oracle exactness*: for any query batch the
+sharded device program — per-shard local top-k, all_gather, shard-major
+merge — must return exactly what ``np.argsort(-scores, kind="stable")``
+returns on the host, including duplicate-score tie rows and k larger than
+a single shard's row count. Corpora and queries are integer-valued
+float32 so every dot product is exact in both float32 (device) and
+float64 (numpy) — parity failures are merge bugs, never rounding.
+
+The corpus must actually live row-sharded in HBM: conftest fakes 8 CPU
+devices, so the uploaded corpus must span all of them, and the kernel may
+never materialize the full (B, n) similarity matrix (pinned by a
+corpus-larger-than-any-one-shard layout assertion, not by inspecting XLA).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from simclr_tpu.serve.metrics import ServeMetrics
+from simclr_tpu.serve.retrieval import NeighborIndex
+
+pytestmark = pytest.mark.serve
+
+
+def int_valued(shape, seed, lo=-8, hi=8):
+    """Integer-valued float32: exact dot products on device and host."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(lo, hi, size=shape).astype(np.float32)
+
+
+def oracle_topk(corpus, queries, k, metric="dot"):
+    """Host reference: stable argsort on descending score (ties -> lowest
+    row id first), float64 numpy — the layout the device merge must match."""
+    c, q = np.asarray(corpus, np.float64), np.asarray(queries, np.float64)
+    if metric == "cosine":
+        c = c / np.maximum(np.linalg.norm(c, axis=1, keepdims=True), 1e-30)
+        q = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-30)
+    scores = q @ c.T
+    idx = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(scores, idx, axis=1), idx
+
+
+class TestOracleParity:
+    def test_exact_including_ties_and_k_beyond_shard(self):
+        # 37 rows over 8 fake devices -> 5 rows/shard (padded to 40): any
+        # k > 5 forces the cross-shard merge to pull multiple winners per
+        # shard, and k == n exercises the fully-exhaustive path
+        corpus = int_valued((37, 16), seed=0, lo=-3, hi=3)
+        corpus[11] = corpus[3]  # duplicate rows: every query ties 3 vs 11
+        corpus[29] = corpus[3]
+        index = NeighborIndex(corpus, max_queries=8)
+        assert index.rows_per_shard < 37 // 2, "corpus must outgrow one shard"
+        queries = int_valued((5, 16), seed=1, lo=-3, hi=3)
+        for k in (1, 4, index.rows_per_shard + 3, 37):
+            vals, idx = index.query(queries, k)
+            ref_vals, ref_idx = oracle_topk(corpus, queries, k)
+            np.testing.assert_array_equal(idx, ref_idx)
+            np.testing.assert_array_equal(vals.astype(np.float64), ref_vals)
+
+    def test_all_tied_rows_return_lowest_indices(self):
+        # a constant corpus ties EVERY row: the contract pins the winner
+        # set to rows 0..k-1 in order (stable tie-break on global row id)
+        corpus = np.ones((19, 4), np.float32)
+        index = NeighborIndex(corpus, max_queries=4)
+        vals, idx = index.query(np.ones((2, 4), np.float32), k=7)
+        np.testing.assert_array_equal(idx, np.tile(np.arange(7), (2, 1)))
+        np.testing.assert_array_equal(vals, np.full((2, 7), 4.0, np.float32))
+
+    def test_cosine_metric_matches_normalized_oracle(self):
+        corpus = int_valued((23, 8), seed=2, lo=1, hi=5)  # nonzero rows
+        queries = int_valued((3, 8), seed=3, lo=1, hi=5)
+        index = NeighborIndex(corpus, metric="cosine", max_queries=4)
+        _, idx = index.query(queries, k=6)
+        _, ref_idx = oracle_topk(corpus, queries, 6, metric="cosine")
+        np.testing.assert_array_equal(idx, ref_idx)
+
+    def test_query_batches_pad_to_buckets_and_results_are_batch_invariant(self):
+        corpus = int_valued((16, 8), seed=4)
+        index = NeighborIndex(corpus, max_queries=8)
+        queries = int_valued((5, 8), seed=5)
+        # 5 queries pad to bucket 8; each row's answer must equal its
+        # answer as a lone (bucket-1) query — padding rows can't leak in
+        vals, idx = index.query(queries, k=3)
+        for i in range(5):
+            v1, i1 = index.query(queries[i : i + 1], k=3)
+            np.testing.assert_array_equal(idx[i : i + 1], i1)
+            np.testing.assert_array_equal(vals[i : i + 1], v1)
+
+
+class TestCorpusResidency:
+    def test_corpus_is_row_sharded_across_all_local_devices(self):
+        index = NeighborIndex(int_valued((40, 8), seed=6))
+        assert index.n_shards == len(jax.local_devices())
+        assert len(index.corpus.sharding.device_set) == len(jax.local_devices())
+        # per-device HBM holds only its row block, not the full corpus
+        (shard,) = {s.data.shape for s in index.corpus.addressable_shards}
+        assert shard == (index.rows_per_shard, 8)
+        state = index.hbm_state()
+        assert state["rows"] == 40 and state["shards"] == index.n_shards
+        assert state["corpus_hbm_bytes"] == index.corpus.nbytes
+
+    def test_corpus_hbm_gauge_set_on_upload(self):
+        metrics = ServeMetrics()
+        index = NeighborIndex(int_valued((10, 4), seed=7), metrics=metrics)
+        assert metrics.corpus_hbm_bytes.value == index.corpus.nbytes > 0
+
+
+class TestFromFile:
+    def test_npy_and_npz_features_layouts(self, tmp_path):
+        corpus = int_valued((9, 6), seed=8)
+        npy = tmp_path / "corpus.npy"
+        np.save(npy, corpus)
+        npz = tmp_path / "feats.npz"
+        np.savez(npz, labels=np.arange(9), features=corpus)
+        for path in (npy, npz):
+            index = NeighborIndex.from_file(str(path), max_queries=4)
+            _, idx = index.query(corpus[:2], k=1)
+            # row i's nearest neighbor under exact dot need not be row i,
+            # but must match the oracle on the same file contents
+            _, ref_idx = oracle_topk(corpus, corpus[:2], 1)
+            np.testing.assert_array_equal(idx, ref_idx)
+
+
+class TestValidation:
+    def test_rejects_bad_construction(self):
+        with pytest.raises(ValueError, match="metric"):
+            NeighborIndex(np.ones((4, 2), np.float32), metric="l2")
+        with pytest.raises(ValueError, match="corpus"):
+            NeighborIndex(np.ones((4,), np.float32))
+        with pytest.raises(ValueError, match="corpus"):
+            NeighborIndex(np.zeros((0, 2), np.float32))
+
+    def test_rejects_bad_queries_and_k(self):
+        index = NeighborIndex(int_valued((12, 4), seed=9), max_queries=4)
+        with pytest.raises(ValueError, match=r"\(B, 4\)"):
+            index.query(np.ones((2, 3), np.float32), k=1)
+        with pytest.raises(ValueError, match="k must be in"):
+            index.query(np.ones((1, 4), np.float32), k=0)
+        with pytest.raises(ValueError, match="k must be in"):
+            index.query(np.ones((1, 4), np.float32), k=13)
+        with pytest.raises(ValueError, match="ceiling"):
+            index.query(np.ones((5, 4), np.float32), k=1)
+        with pytest.raises(ValueError, match="at least one"):
+            index.query(np.zeros((0, 4), np.float32), k=1)
+
+
+class TestNeighborsEndpoint:
+    """/v1/neighbors through a live HTTP server (shares LiveServer idiom
+    with test_serve_server; the embed engine rides along untouched)."""
+
+    @pytest.fixture
+    def live_with_index(self):
+        import jax.numpy as jnp
+
+        from simclr_tpu.serve.engine import EmbedEngine
+        from simclr_tpu.serve.server import shutdown_gracefully, start_server
+        from tests.helpers import TinyContrastive
+        from tests.test_serve_server import LiveServer, serve_cfg
+
+        corpus = int_valued((21, 16), seed=10)
+        corpus[8] = corpus[2]  # tie through HTTP too
+        model = TinyContrastive(bn_cross_replica_axis=None)
+        variables = jax.tree.map(
+            np.asarray, model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+        )
+        metrics = ServeMetrics()
+        engine = EmbedEngine(model, variables, max_batch=8, metrics=metrics)
+        index = NeighborIndex(corpus, max_queries=8, metrics=metrics)
+        server, batcher = start_server(
+            serve_cfg(**{"serve.neighbors_k": 3}),
+            engine=engine, metrics=metrics, index=index,
+        )
+        ls = LiveServer(server, batcher, engine, metrics)
+        ls.corpus = corpus
+        yield ls
+        shutdown_gracefully(server, drain_timeout_s=10)
+        ls.thread.join(timeout=10)
+        server.server_close()
+
+    def test_roundtrip_matches_oracle(self, live_with_index):
+        queries = int_valued((3, 16), seed=11)
+        status, body, _ = live_with_index.request(
+            "POST", "/v1/neighbors", {"queries": queries.tolist(), "k": 9}
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["k"] == 9 and payload["metric"] == "dot"
+        ref_vals, ref_idx = oracle_topk(live_with_index.corpus, queries, 9)
+        np.testing.assert_array_equal(np.asarray(payload["indices"]), ref_idx)
+        np.testing.assert_array_equal(np.asarray(payload["scores"]), ref_vals)
+
+    def test_default_k_from_config(self, live_with_index):
+        queries = int_valued((1, 16), seed=12)
+        status, body, _ = live_with_index.request(
+            "POST", "/v1/neighbors", {"queries": queries.tolist()}
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["k"] == 3
+        assert np.asarray(payload["indices"]).shape == (1, 3)
+
+    def test_healthz_reports_corpus_residency(self, live_with_index):
+        status, body, _ = live_with_index.request("GET", "/healthz")
+        assert status == 200
+        neighbors = json.loads(body)["neighbors"]
+        assert neighbors["rows"] == 21
+        assert neighbors["shards"] == len(jax.local_devices())
+        assert neighbors["corpus_hbm_bytes"] > 0
+
+    def test_bad_bodies_400(self, live_with_index):
+        req = live_with_index.request
+        assert req("POST", "/v1/neighbors")[0] == 400  # no body
+        assert req("POST", "/v1/neighbors", {"wrong": []})[0] == 400
+        ragged = {"queries": [[1.0, 2.0], [3.0]]}
+        assert req("POST", "/v1/neighbors", ragged)[0] == 400
+        wrong_dim = {"queries": [[1.0, 2.0]]}
+        assert req("POST", "/v1/neighbors", wrong_dim)[0] == 400
+        q = np.ones((1, 16)).tolist()
+        assert req("POST", "/v1/neighbors", {"queries": q, "k": 0})[0] == 400
+        assert req("POST", "/v1/neighbors", {"queries": q, "k": 22})[0] == 400
+        assert req("POST", "/v1/neighbors", {"queries": q, "k": True})[0] == 400
+        too_many = {"queries": np.ones((9, 16)).tolist()}
+        assert req("POST", "/v1/neighbors", too_many)[0] == 400
+
+    def test_404_without_corpus_and_503_draining(self, live_with_index):
+        q = {"queries": np.ones((1, 16)).tolist()}
+        live_with_index.server.draining.set()
+        try:
+            assert live_with_index.request("POST", "/v1/neighbors", q)[0] == 503
+        finally:
+            live_with_index.server.draining.clear()
+        real = live_with_index.server.index
+        live_with_index.server.index = None
+        try:
+            status, body, _ = live_with_index.request("POST", "/v1/neighbors", q)
+            assert status == 404
+            assert "serve.corpus" in json.loads(body)["error"]
+        finally:
+            live_with_index.server.index = real
+
+    def test_neighbors_metrics_counted(self, live_with_index):
+        from tests.test_serve_server import metric_value
+
+        queries = int_valued((2, 16), seed=13)
+        status, _, _ = live_with_index.request(
+            "POST", "/v1/neighbors", {"queries": queries.tolist(), "k": 1}
+        )
+        assert status == 200
+        text = live_with_index.request("GET", "/metrics")[1].decode()
+        assert metric_value(text, "simclr_serve_neighbors_requests_total") >= 1
+        assert metric_value(text, "simclr_serve_neighbors_queries_total") >= 2
+        assert metric_value(text, "simclr_serve_corpus_hbm_bytes") > 0

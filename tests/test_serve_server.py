@@ -397,6 +397,10 @@ class TestSubprocessSigterm:
                 f"serve.checkpoint={ckpt}", "serve.port=0",
                 f"serve.ready_file={ready}", "serve.max_batch=4",
                 "serve.max_delay_ms=300", "serve.queue_depth=16",
+                # single replica: this test is the drain contract; the
+                # replicated drain is TestMultiReplicaSigterm (the default
+                # replicas=-1 would warm one engine per virtual device here)
+                "serve.replicas=1",
             ],
             env=env,
             stdout=subprocess.PIPE,

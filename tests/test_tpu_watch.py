@@ -22,7 +22,7 @@ STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "augment_bench", "multihost_dryrun", "remat2048",
     "explore1024", "explore512", "supervisor_smoke", "obs_smoke",
-    "compile_audit", "superepoch", "run_report",
+    "compile_audit", "superepoch", "serve_scale", "run_report",
 )
 
 
@@ -109,6 +109,17 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         "echo 'superepoch_parity OK k=4 max_rel_loss_diff=1.20e-04'; "
         "echo 'superepoch_compiles_total 2'; "
         "echo 'superepoch_recompile_alarms_total 0';; esac",
+        # the serve_scale stage greps its stdout for an error-free payload
+        # whose scaling block proves >= 2 replicas, a p99 column, and a
+        # quiet recompile sentry (serve_bench.py exits 0 even on error);
+        # the *bench.py* case below also substring-matches this invocation,
+        # harmlessly re-touching the capture
+        'case "$*" in *serve_bench.py*) '
+        'echo \'{"metric": "serve_requests_per_sec", "value": 406.7, '
+        '"unit": "req/s", "p50_ms": 18.4, "p99_ms": 39.8, '
+        '"recompile_alarms": 0, "replicas": 4, '
+        '"scaling": {"replicas": 4, "single_rps": 195.2, '
+        '"multi_rps": 406.7, "speedup": 2.08}}\';; esac',
         # the run_report stage greps for a COMPUTED verdict (OK|REGRESSION):
         # a NO_DATA/NO_BASELINE report exits 0 but proves nothing
         'case "$*" in *simclr_tpu.obs.report*) '
@@ -369,6 +380,46 @@ def test_superepoch_marker_requires_parity_and_quiet_sentry(tmp_path):
     r, state, log = _run_oneshot(tmp_path)
     assert "superepoch" not in _done(state)
     assert (state / "superepoch.fails").exists()
+
+
+def test_serve_scale_marker_requires_multi_replica_scaling(tmp_path):
+    """serve_bench.py exits 0 even when the replica sweep degraded to a
+    single replica (no spare devices) — a scaling block with replicas < 2
+    proves nothing about fan-out and must not earn serve_scale.done; nor
+    must a payload whose serve-path sentry fired post-warmup."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"scaling": {"replicas": 4, "single_rps"',
+        '"scaling": {"replicas": 1, "single_rps"'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "serve_scale" not in _done(state)
+    assert (state / "serve_scale.fails").exists()
+    assert "stage serve_scale FAILED" in log.read_text()
+    # the stages sharing the window must be untouched
+    assert "superepoch" in _done(state)
+
+    # second contract: scaling proven but a recompile alarm fired mid-bench
+    stub.write_text(stub.read_text()
+                    .replace('"scaling": {"replicas": 1, "single_rps"',
+                             '"scaling": {"replicas": 4, "single_rps"')
+                    .replace('"recompile_alarms": 0, "replicas": 4',
+                             '"recompile_alarms": 3, "replicas": 4'))
+    (state / "serve_scale.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "serve_scale" not in _done(state)
+    assert (state / "serve_scale.fails").exists()
+
+    # third contract: the last-ditch error payload also exits 0
+    stub.write_text(stub.read_text()
+                    .replace('"recompile_alarms": 3, "replicas": 4',
+                             '"recompile_alarms": 0, "replicas": 4')
+                    .replace('"speedup": 2.08}}',
+                             '"speedup": 2.08}, "error": "boom"}'))
+    (state / "serve_scale.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "serve_scale" not in _done(state)
+    assert (state / "serve_scale.fails").exists()
 
 
 def test_run_report_marker_requires_computed_verdict(tmp_path):

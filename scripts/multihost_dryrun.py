@@ -23,9 +23,25 @@ and ``"parity": true``. Robustness contract (same as allreduce_bench.py):
 never exits nonzero, never ends on a traceback, emits EXACTLY ONE payload
 line; failures land in an ``"error"`` field.
 
+``--elastic`` runs the OTHER multi-host proof instead — the elastic
+supervisor's full kill/remesh/grow-back cycle (the ``elastic_dryrun``
+watcher stage): a 2-process CPU pretrain whose process 1 is hard-killed
+mid-run via ``SIMCLR_FAULT_DIE_PROCESS``, which must remesh down to 1
+process, resume from the last verified checkpoint with the global batch
+preserved, grow back to 2 processes, and finish clean — then an
+uninterrupted same-seed single-process run on the same 8-device global
+mesh, with per-epoch loss-trajectory parity within 5e-2 (reduction order
+differs across topologies, so bitwise is not expected). Its payload::
+
+    {"metric": "elastic_dryrun", "value": 1.0, "unit": "bool",
+     "outcome": "clean", "remesh_count": 2, "grow_back_count": 1,
+     "hosts": [2, 1, 2], "parity": true, ...}
+
 Env knobs: ``MULTIHOST_DRYRUN_TIMEOUT_S`` (per-phase subprocess timeout,
 default 300), ``MULTIHOST_DRYRUN_COORD_TIMEOUT_S`` (rendezvous fail-fast
-deadline exported as ``JAX_COORDINATOR_TIMEOUT_S``, default 60).
+deadline exported as ``JAX_COORDINATOR_TIMEOUT_S``, default 60),
+``ELASTIC_DRYRUN_TIMEOUT_S`` (the elastic phase's own timeout, default 1200
+— it spans three compile-from-scratch generations).
 """
 
 from __future__ import annotations
@@ -36,6 +52,7 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
@@ -43,6 +60,9 @@ sys.path.insert(0, REPO_ROOT)
 WORKER_MODULE = "simclr_tpu.multihost_dryrun"
 NPROCS = 2
 DEVICES_PER_PROC = 4
+
+# which payload the error backstops stamp; flipped by --elastic
+_METRIC = "multihost_dryrun_parity"
 
 _PAYLOAD_EMITTED = False
 
@@ -58,7 +78,7 @@ def _emit_payload(payload: dict) -> None:
 
 def last_ditch_payload(exc: BaseException) -> dict:
     return {
-        "metric": "multihost_dryrun_parity",
+        "metric": _METRIC,
         "value": 0.0,
         "unit": "bool",
         "parity": False,
@@ -112,18 +132,12 @@ def _run(cmd: list[str], env: dict, timeout_s: float, label: str) -> dict:
     return _parse_worker_line(proc.stdout, label)
 
 
-def main() -> None:
-    try:
-        signal.signal(signal.SIGTERM, _sigterm_backstop)
-    except ValueError:  # non-main thread (embedded runs)
-        pass
-    timeout_s = float(os.environ.get("MULTIHOST_DRYRUN_TIMEOUT_S", 300))
-    coord_timeout = os.environ.get("MULTIHOST_DRYRUN_COORD_TIMEOUT_S", "60")
-
-    base_env = {
+def _scrubbed_env() -> dict:
+    """os.environ minus any ambient rendezvous/backend config, so each phase
+    fully controls its own; plus the fail-fast coordinator deadline."""
+    env = {
         k: v
         for k, v in os.environ.items()
-        # scrub any ambient rendezvous config so each phase fully controls it
         if k
         not in (
             "JAX_COORDINATOR_ADDRESS",
@@ -134,8 +148,211 @@ def main() -> None:
             "XLA_FLAGS",
         )
     }
+    env["JAX_COORDINATOR_TIMEOUT_S"] = os.environ.get(
+        "MULTIHOST_DRYRUN_COORD_TIMEOUT_S", "60"
+    )
+    return env
+
+
+# Elastic recipe: 4 global devices (2 processes x 2 — fewer virtual CPU
+# devices than the parity dryrun because XLA device threads oversubscribe
+# a CI core), global batch 16 (4 per device x 4), synthetic 16 samples ->
+# ONE step/epoch (the lightest epoch that still walks the whole
+# restore/remesh machinery); one checkpoint per epoch so every epoch
+# boundary is a restore point. The survivor topology (1 process x 2
+# devices) divides the global batch (-> 8 per device), so the remesh
+# preserves it. epoch_compile exercises the strictest resume contract
+# (boundary-only) across the topology change. Three epochs is the
+# minimum lifecycle: epoch 1 (checkpoint) -> die at the epoch-2 beat ->
+# shrunken epoch 2 -> grow-back drain -> full-size epoch 3.
+ELASTIC_DEVICES_PER_PROC = 2
+ELASTIC_RECIPE = [
+    "experiment.synthetic_data=true",
+    "experiment.synthetic_size=16",
+    "experiment.batches=4",
+    "parameter.epochs=3",
+    "parameter.warmup_epochs=1",
+    "experiment.save_model_epoch=1",
+    "runtime.epoch_compile=true",
+    # policy tuned for a CI-speed cycle: near-instant group relaunch, 1 s
+    # lost-host cooldown so grow-back triggers right after the shrunken
+    # generation's first completed epoch
+    "supervisor.backoff_base_s=0.1",
+    "supervisor.backoff_max_s=2.0",
+    "supervisor.grow_back_cooldown_s=1.0",
+    "supervisor.startup_grace_s=600.0",
+    # under epoch_compile the guard beats once per EPOCH, and a contended
+    # CI epoch can run minutes — the default 30 s floor would declare a
+    # live host wedged mid-epoch, so park hang detection out of the way
+    # (this e2e injects a hard DIE, not a wedge)
+    "supervisor.heartbeat_min_timeout_s=900.0",
+]
+
+# steps/epoch = 1, and the guard beats once per epoch (at steps 1, 2, 3...):
+# 1:2 hard-kills process 1 at its epoch-2 beat — BEFORE that epoch's
+# checkpoint lands, so the remeshed generation must resume from epoch 1
+ELASTIC_DIE_FAULT = "1:2"
+
+
+def _load_results(save_dir: str, label: str) -> dict:
+    path = os.path.join(save_dir, "pretrain_results.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as exc:
+        raise RuntimeError(f"{label}: unreadable {path}: {exc!r}") from exc
+
+
+def _event_counts(save_dir: str) -> dict:
+    counts: dict[str, int] = {}
+    try:
+        with open(os.path.join(save_dir, "events.jsonl"), encoding="utf-8") as f:
+            for line in f:
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue
+                kind = event.get("event")
+                if isinstance(kind, str):
+                    counts[kind] = counts.get(kind, 0) + 1
+    except OSError:
+        pass
+    return counts
+
+
+def elastic_main() -> None:
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_backstop)
+    except ValueError:
+        pass
+    timeout_s = float(os.environ.get("ELASTIC_DRYRUN_TIMEOUT_S", 1200))
+    base_env = _scrubbed_env()
+    workdir = tempfile.mkdtemp(prefix="elastic_dryrun_")
+    elastic_dir = os.path.join(workdir, "elastic")
+    ref_dir = os.path.join(workdir, "reference")
+
+    # phase 1: elastic run — process 1 hard-killed at its epoch-2 beat
+    elastic_env = dict(base_env)
+    elastic_env["SIMCLR_FAULT_DIE_PROCESS"] = ELASTIC_DIE_FAULT
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "simclr_tpu.supervisor.elastic",
+            "--nprocs", str(NPROCS),
+            "--devices-per-proc", str(ELASTIC_DEVICES_PER_PROC),
+            "--force-cpu",
+            "--coord-timeout-s", base_env["JAX_COORDINATOR_TIMEOUT_S"],
+            "--", "pretrain", *ELASTIC_RECIPE,
+            f"experiment.save_dir={elastic_dir}",
+        ],
+        env=elastic_env, capture_output=True, text=True, timeout=timeout_s,
+        cwd=REPO_ROOT,
+    )
+    for line in proc.stderr.splitlines()[-20:]:
+        print(f"# [elastic] {line}", file=sys.stderr)
+    summary = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                summary = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    if summary is None:
+        raise RuntimeError(
+            f"elastic supervisor exited {proc.returncode} with no summary line"
+        )
+
+    # phase 2: uninterrupted same-seed reference on the same 4-device
+    # global mesh, single process
+    ref_env = dict(base_env)
+    ref_env["JAX_PLATFORMS"] = "cpu"
+    ref_env["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        f"{NPROCS * ELASTIC_DEVICES_PER_PROC}"
+    )
+    ref = subprocess.run(
+        [
+            sys.executable, "-m", "simclr_tpu.main", *ELASTIC_RECIPE,
+            f"experiment.save_dir={ref_dir}",
+        ],
+        env=ref_env, capture_output=True, text=True, timeout=timeout_s,
+        cwd=REPO_ROOT,
+    )
+    for line in ref.stderr.splitlines()[-10:]:
+        print(f"# [reference] {line}", file=sys.stderr)
+    if ref.returncode != 0:
+        raise RuntimeError(f"reference run exited {ref.returncode}")
+
+    # loss-trajectory parity: same epochs, every per-epoch loss within 5e-2
+    # (cross-topology reduction order shifts floats; the trajectory must not
+    # fork beyond that)
+    elastic_hist = _load_results(elastic_dir, "elastic").get("loss_history", [])
+    ref_hist = _load_results(ref_dir, "reference").get("loss_history", [])
+    elastic_losses = {int(e): float(v) for e, v in elastic_hist}
+    ref_losses = {int(e): float(v) for e, v in ref_hist}
+    epochs_match = sorted(elastic_losses) == sorted(ref_losses) and elastic_losses
+    max_delta = (
+        max(abs(elastic_losses[e] - ref_losses[e]) for e in elastic_losses)
+        if epochs_match else None
+    )
+    parity = bool(epochs_match) and max_delta is not None and max_delta <= 5e-2
+
+    events = _event_counts(elastic_dir)
+    events_ok = all(
+        events.get(kind, 0) >= 1
+        for kind in ("host_lost", "remesh", "grow_back")
+    )
+    outcome = summary.get("outcome")
+    remesh_count = int(summary.get("remesh_count", 0) or 0)
+    grow_back_count = int(summary.get("grow_back_count", 0) or 0)
+    ok = (
+        outcome == "clean"
+        and proc.returncode == 0
+        and remesh_count >= 1
+        and grow_back_count >= 1
+        and parity
+        and events_ok
+    )
+    payload = {
+        "metric": "elastic_dryrun",
+        "value": 1.0 if ok else 0.0,
+        "unit": "bool",
+        "outcome": outcome,
+        "remesh_count": remesh_count,
+        "grow_back_count": grow_back_count,
+        "hosts": summary.get("hosts_timeline"),
+        "parity": parity,
+        "max_loss_delta": max_delta,
+        "events": {
+            k: events.get(k, 0) for k in ("host_lost", "remesh", "grow_back")
+        },
+        "supervisor": summary,
+    }
+    if not ok:
+        failures = []
+        if outcome != "clean":
+            failures.append(f"outcome={outcome}")
+        if remesh_count < 1:
+            failures.append("no remesh")
+        if grow_back_count < 1:
+            failures.append("no grow-back")
+        if not parity:
+            failures.append(f"loss trajectory diverged (max delta {max_delta})")
+        if not events_ok:
+            failures.append(f"missing elastic events ({events})")
+        payload["error"] = "; ".join(failures) or "unknown failure"
+    _emit_payload(payload)
+
+
+def main() -> None:
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_backstop)
+    except ValueError:  # non-main thread (embedded runs)
+        pass
+    timeout_s = float(os.environ.get("MULTIHOST_DRYRUN_TIMEOUT_S", 300))
     # a wedged coordinator fails in ~1 min, not jax's 5-minute default
-    base_env["JAX_COORDINATOR_TIMEOUT_S"] = coord_timeout
+    base_env = _scrubbed_env()
 
     # phase 1: real 2-process rendezvous, 4 CPU devices each => 8 global
     multi_cmd = [
@@ -181,8 +398,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    elastic_mode = "--elastic" in sys.argv[1:]
+    if elastic_mode:
+        _METRIC = "elastic_dryrun"
     try:
-        main()
+        elastic_main() if elastic_mode else main()
     except Exception as exc:  # last-ditch contract keeper: one line, rc 0
         print(f"# unexpected error: {exc!r}", file=sys.stderr)
         _emit_payload(last_ditch_payload(exc))

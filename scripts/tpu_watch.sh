@@ -54,7 +54,7 @@ MAX_STAGE_FAILS=3
 # chip lock — proves the pod code path on the host), then the remaining
 # step matrices, and last the supervisor kill/resume smoke (fault
 # tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench augment_bench multihost_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench augment_bench multihost_dryrun elastic_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -242,6 +242,29 @@ run_stage() {
             cat "$out" >> "$LOG"
             if [ "$rc" -eq 0 ]; then
                 grep -q '"process_count": 2' "$out" \
+                    && grep -q '"parity": true' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        elastic_dryrun)
+            # elastic remesh/grow-back e2e (scripts/multihost_dryrun.py
+            # --elastic): a 2-process CPU pretrain whose process 1 is
+            # hard-killed mid-run must remesh down to 1 process, resume
+            # from the last verified checkpoint with the global batch
+            # preserved, grow back to 2 processes, and finish clean with a
+            # loss trajectory matching an uninterrupted same-seed run.
+            # CPU-only like multihost_dryrun — no chip lock. The script
+            # exits 0 even on error, so the done marker requires a clean
+            # outcome WITH at least one remesh AND trajectory parity and
+            # no error field.
+            out="$STATE/elastic_dryrun.out"
+            timeout "$(stage_timeout 1800)" python scripts/multihost_dryrun.py \
+                --elastic > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"outcome": "clean"' "$out" \
+                    && grep -Eq '"remesh_count": [1-9]' "$out" \
                     && grep -q '"parity": true' "$out" \
                     && ! grep -q '"error"' "$out"
                 rc=$?

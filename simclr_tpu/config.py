@@ -590,6 +590,24 @@ def _check_supervisor_conf(cfg: Config) -> None:
         isinstance(backoff, (int, float)) and 0 <= backoff <= 3600,
         f"supervisor.backoff_base_s must be in [0, 3600] seconds, got {backoff!r}",
     )
+    backoff_max = cfg.select("supervisor.backoff_max_s", 300.0)
+    _require(
+        isinstance(backoff_max, (int, float)) and 0 <= backoff_max <= 86400,
+        f"supervisor.backoff_max_s must be in [0, 86400] seconds, "
+        f"got {backoff_max!r}",
+    )
+    _require(
+        backoff_max >= backoff,
+        f"supervisor.backoff_max_s ({backoff_max!r}) must be >= "
+        f"supervisor.backoff_base_s ({backoff!r}) — a cap below the base "
+        "delay would make every restart wait the cap",
+    )
+    grow_back = cfg.select("supervisor.grow_back_cooldown_s", 60.0)
+    _require(
+        isinstance(grow_back, (int, float)) and 0 <= grow_back <= 86400,
+        f"supervisor.grow_back_cooldown_s must be in [0, 86400] seconds, "
+        f"got {grow_back!r}",
+    )
     factor = cfg.select("supervisor.heartbeat_timeout_factor", 10.0)
     _require(
         isinstance(factor, (int, float)) and 1 <= factor <= 1000,

@@ -51,6 +51,7 @@ from simclr_tpu.parallel.mesh import (
     MODEL_AXIS,
     batch_sharding,
     mesh_from_config,
+    mesh_host_count,
     put_replicated,
     put_row_sharded,
     put_tree,
@@ -71,6 +72,11 @@ from simclr_tpu.supervisor.guard import (
     RunGuard,
     preempt_checkpoint_name,
     resume_point,
+)
+from simclr_tpu.supervisor.topology import (
+    check_resume_topology,
+    read_topology,
+    write_topology,
 )
 from simclr_tpu.utils.checkpoint import (
     CheckpointCorruptionError,
@@ -175,11 +181,13 @@ def run_pretrain(cfg: Config) -> dict:
     # run telemetry (simclr_tpu/obs/, docs/OBSERVABILITY.md): metric
     # registry + events.jsonl timeline, fed only host floats the loop
     # already fetches — scraping adds zero device syncs
+    n_hosts = mesh_host_count(mesh)
     telemetry = Telemetry(
         arch=str(cfg.experiment.base_cnn),
         per_device_batch=int(cfg.experiment.batches),
         global_batch=global_batch,
         n_devices=jax.device_count(),
+        mesh_hosts=n_hosts,
         d=int(cfg.parameter.d),
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
         grad_elements=param_count(state.params),
@@ -197,6 +205,7 @@ def run_pretrain(cfg: Config) -> dict:
         nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
         telemetry=telemetry,
         events=events,
+        process_index=jax.process_index(),
     )
     # step anomaly detection (obs/anomaly.py): rolling median/MAD slow-step
     # classifier + stall watchdog + rate-limited auto-trace — host clock
@@ -220,6 +229,12 @@ def run_pretrain(cfg: Config) -> dict:
     )
     start_epoch = 1
     skip_steps = 0
+    # the PRIOR generation's topology record, read BEFORE this run
+    # overwrites the sidecar below — the elastic remesh accept/reject input
+    prior_topology = (
+        read_topology(save_dir)
+        if bool(cfg.select("experiment.resume", False)) else None
+    )
     if bool(cfg.select("experiment.resume", False)):
         # newest checkpoint whose sha256 sidecar verifies; a corrupt latest
         # falls back to the previous one instead of failing the run
@@ -231,6 +246,30 @@ def run_pretrain(cfg: Config) -> dict:
             start_epoch, skip_steps = resume_point(
                 int(state.step), steps_per_epoch
             )
+            # cross-topology resume (elastic remesh): accepted only when the
+            # global batch is preserved and the checkpoint sits on an epoch
+            # boundary; anything else raises here, before any compile. The
+            # HBM preflight is inherently revalidated — the epoch_compile
+            # precondition check below runs against the CURRENT mesh.
+            topology_change = check_resume_topology(
+                prior_topology,
+                n_devices=jax.device_count(),
+                n_processes=n_hosts,
+                global_batch=global_batch,
+                skip_steps=skip_steps,
+            )
+            if topology_change is not None:
+                events.emit("topology_change", **topology_change)
+                logger.info(
+                    "Cross-topology resume: %d -> %d devices "
+                    "(%d -> %d hosts), per-device batch now %d "
+                    "(global batch %d preserved)",
+                    topology_change["devices_before"],
+                    topology_change["devices_after"],
+                    topology_change["hosts_before"],
+                    topology_change["hosts_after"],
+                    topology_change["per_device_batch"], global_batch,
+                )
             # re-seat the timeline like pretrain_results.json below: drop
             # epoch/checkpoint events this run is about to re-emit
             events.reseat(start_epoch)
@@ -243,6 +282,13 @@ def run_pretrain(cfg: Config) -> dict:
                 f" (skipping {skip_steps} already-consumed steps)"
                 if skip_steps else "",
             )
+    if is_logging_host():
+        write_topology(
+            save_dir,
+            n_devices=jax.device_count(),
+            n_processes=n_hosts,
+            global_batch=global_batch,
+        )
 
     step_kwargs = dict(
         temperature=float(cfg.parameter.temperature),
@@ -881,6 +927,30 @@ def run_pretrain(cfg: Config) -> dict:
                 # exit 75 via main() — at an exact epoch boundary this is the
                 # regular boundary checkpoint; mid-epoch it gets "-preempt"
                 timer.pause(metrics["loss"])
+                epoch_loss = float(metrics["loss"])
+                if (
+                    cur_step == epoch * steps_per_epoch
+                    and math.isfinite(epoch_loss)
+                    and (not loss_history or loss_history[-1][0] < epoch)
+                ):
+                    # the preempt landed on a completed epoch (elastic
+                    # grow-back drains SIGTERM at exactly this boundary):
+                    # its loss row and epoch event are in hand — persist
+                    # them, or the resumed run's history skips this epoch
+                    loss_history.append([epoch, epoch_loss])
+                    events.emit(
+                        "epoch", epoch=epoch, step=cur_step, loss=epoch_loss,
+                        seconds=round(time.perf_counter() - epoch_t0, 6),
+                    )
+                    write_results(
+                        {
+                            "epochs": epochs,
+                            "save_dir": save_dir,
+                            "loss_history": loss_history,
+                            "monitor_history": monitor_history,
+                            "complete": False,
+                        }
+                    )
                 path = os.path.join(
                     save_dir,
                     preempt_checkpoint_name(cur_step, steps_per_epoch, stem),

@@ -53,6 +53,9 @@ _COUNTED_EVENTS = {
     "compile": "compiles",
     "recompile_alarm": "recompile_alarms",
     "oom": "ooms",
+    "host_lost": "hosts_lost",
+    "remesh": "remeshes",
+    "grow_back": "grow_backs",
 }
 
 
@@ -113,11 +116,20 @@ def build_report(
                 "last_time": None,
                 "compile_seconds": 0.0,
                 "hbm_peak_per_device": {},
+                "host_transitions": [],
             },
         )
         kind = event.get("event")
         if kind in _COUNTED_EVENTS:
             entry[_COUNTED_EVENTS[kind]] += 1
+        if kind == "remesh":
+            # per-attempt host timeline, rendered as "hosts: 2→1→2"
+            transitions = entry["host_transitions"]
+            before, after = event.get("hosts_before"), event.get("hosts_after")
+            if isinstance(before, int) and not transitions:
+                transitions.append(before)
+            if isinstance(after, int):
+                transitions.append(after)
         elif kind == "child_exit":
             entry["exit"] = event.get("exit")
             entry["hung"] = bool(event.get("hung"))
@@ -151,6 +163,18 @@ def build_report(
         a for a, entry in attempts.items() if entry["stalls"] or entry["hung"]
     )
 
+    # run-level host timeline ("hosts: 2→1→2") stitched from the remesh
+    # events in order; empty for non-elastic runs
+    hosts_timeline: list[int] = []
+    for event in events:
+        if event.get("event") != "remesh":
+            continue
+        before, after = event.get("hosts_before"), event.get("hosts_after")
+        if isinstance(before, int) and not hosts_timeline:
+            hosts_timeline.append(before)
+        if isinstance(after, int):
+            hosts_timeline.append(after)
+
     heartbeat = read_heartbeat(heartbeat_path(run_dir))
     telemetry = None
     if heartbeat is not None and isinstance(heartbeat.get("telemetry"), dict):
@@ -183,6 +207,7 @@ def build_report(
         "run_dir": os.path.abspath(run_dir),
         "attempts": {str(a): attempts[a] for a in sorted(attempts)},
         "stalled_attempts": stalled,
+        "hosts_timeline": hosts_timeline,
         "outcome": supervisor.get("outcome") if supervisor else None,
         "supervisor": supervisor,
         "heartbeat": heartbeat,
@@ -203,6 +228,10 @@ def render_report(report: dict) -> str:
             f"outcome: {report['outcome']} "
             f"(exit {supervisor.get('exit')}, "
             f"resumed {supervisor.get('resumed', 0)}x)"
+        )
+    if report.get("hosts_timeline"):
+        lines.append(
+            "hosts: " + "→".join(str(n) for n in report["hosts_timeline"])
         )
     for attempt, entry in report["attempts"].items():
         duration = (
@@ -230,6 +259,17 @@ def render_report(report: dict) -> str:
                 f"  compiles: {entry['compiles']} "
                 f"({entry['compile_seconds']:.2f}s total)"
                 f"{alarm_part}{oom_part}"
+            )
+        if entry.get("hosts_lost") or entry.get("grow_backs"):
+            transition = (
+                " hosts: "
+                + "→".join(str(n) for n in entry["host_transitions"])
+                if entry.get("host_transitions") else ""
+            )
+            lines.append(
+                f"  elastic: hosts_lost={entry['hosts_lost']} "
+                f"remeshes={entry['remeshes']} "
+                f"grow_backs={entry['grow_backs']}{transition}"
             )
         if entry["hbm_peak_per_device"]:
             peaks = " ".join(

@@ -95,6 +95,7 @@ class Telemetry:
         per_device_batch: int,
         global_batch: int,
         n_devices: int,
+        mesh_hosts: int = 1,
         d: int = 128,
         grad_allreduce: str = "exact",
         grad_elements: int | None = None,
@@ -178,6 +179,12 @@ class Telemetry:
             "simclr_train_recompile_alarms_total",
             "Post-warmup recompilations of a watched step function — the "
             "silent TPU perf killer")
+        self.mesh_hosts = Gauge(
+            "simclr_train_mesh_hosts",
+            "Host processes backing the current mesh — drops on an elastic "
+            "remesh-down, recovers on grow-back (parallel/mesh.py "
+            "mesh_host_count)")
+        self.mesh_hosts.set(float(max(int(mesh_hosts), 1)))
         self.mfu_xla_drift = Gauge(
             "simclr_train_mfu_roofline_xla_drift",
             "Fractional drift of the roofline FLOP model feeding the live "
@@ -207,7 +214,7 @@ class Telemetry:
             self.checkpoint_saves, self.nan_rollbacks,
             self.anomaly_slow_steps, self.anomaly_stalls, self.auto_traces,
             self.scrape_disconnects, self.compiles, self.compile_seconds,
-            self.recompile_alarms, self.mfu_xla_drift,
+            self.recompile_alarms, self.mesh_hosts, self.mfu_xla_drift,
         )
         self._started = time.time()
 
@@ -318,6 +325,7 @@ class Telemetry:
             "auto_traces": self.auto_traces.value,
             "compiles": self.compiles.value,
             "recompile_alarms": self.recompile_alarms.value,
+            "mesh_hosts": self.mesh_hosts.value,
             "uptime_s": round(time.time() - self._started, 3),
         }
 

@@ -289,15 +289,22 @@ def fused_two_views(
     images: jnp.ndarray,
     strength: float = 0.5,
     out_size: int = 32,
+    *,
+    keys: jax.Array | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Both SimCLR views of a uint8 (or float) batch in one VMEM pass.
 
     Key schedule is identical to ``steps._augment_two_views``' XLA path:
     ``split(rng, 2n)``, first half view 0, second half view 1 — so equal
-    seeds draw bit-identical augmentation parameters on either impl.
+    seeds draw bit-identical augmentation parameters on either impl. The
+    training step passes precomputed ``keys`` (same (2n,) layout) so the
+    per-sample streams can be derived from GLOBAL batch position instead
+    (layout-invariant across elastic remeshes, see
+    ``steps._global_sample_keys``); ``rng`` is ignored then.
     """
     n = images.shape[0]
-    keys = jax.random.split(rng, 2 * n)
+    if keys is None:
+        keys = jax.random.split(rng, 2 * n)
     v0, v1 = _fused_views(images, (keys[:n], keys[n:]), strength, out_size)
     return v0, v1
 
@@ -307,10 +314,14 @@ def fused_one_view(
     images: jnp.ndarray,
     strength: float = 0.5,
     out_size: int = 32,
+    *,
+    keys: jax.Array | None = None,
 ) -> jnp.ndarray:
     """Single augmented view (the supervised baseline's consumption —
-    ``split(rng, n)``, same key schedule as its XLA path)."""
+    ``split(rng, n)``, same key schedule as its XLA path; ``keys``
+    overrides the schedule exactly as in :func:`fused_two_views`)."""
     n = images.shape[0]
-    keys = jax.random.split(rng, n)
+    if keys is None:
+        keys = jax.random.split(rng, n)
     (view,) = _fused_views(images, (keys,), strength, out_size)
     return view

@@ -292,6 +292,15 @@ def num_data_shards(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS]
 
 
+def mesh_host_count(mesh: Mesh) -> int:
+    """Distinct host processes backing the mesh's devices — the value of the
+    ``simclr_train_mesh_hosts`` gauge and the denominator of every elastic
+    remesh decision. Counted from the mesh itself (not ``process_count()``)
+    so a mesh deliberately built over a device subset reports its own
+    footprint."""
+    return len({d.process_index for d in mesh.devices.flat})
+
+
 def validate_per_device_batch(per_device_batch: int, mesh: Mesh) -> int:
     """Global batch from the reference's per-device semantics.
 

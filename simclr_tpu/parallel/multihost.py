@@ -28,6 +28,57 @@ logger = get_logger()
 
 _initialized = False
 
+# every env var that parameterizes one process-group generation; a remesh
+# must rewrite ALL of them (a stale JAX_NUM_PROCESSES from the old topology
+# would hang the new rendezvous waiting for hosts that no longer exist)
+GROUP_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+)
+
+
+def group_env(
+    base: dict,
+    *,
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    devices_per_proc: int | None = None,
+    coord_timeout_s: float | None = None,
+) -> dict:
+    """Child env for ONE generation of a process group.
+
+    A live ``jax.distributed`` group cannot be resized: elasticity is a full
+    teardown of the old group's processes plus a relaunch under a REWRITTEN
+    rendezvous env — new coordinator port, new ``JAX_NUM_PROCESSES``, ranks
+    reassigned 0..N-1 over the surviving hosts. This helper is the one place
+    that rewrite happens (the elastic supervisor composes every child env
+    through it): stale group vars are scrubbed from ``base`` first, so a
+    child can never rendezvous against the previous topology.
+
+    ``devices_per_proc`` forces the CPU backend with that many virtual
+    devices (the 2-process dryrun harness); ``coord_timeout_s`` exports the
+    fail-fast rendezvous deadline ``maybe_initialize_multihost`` honors.
+    """
+    env = {k: v for k, v in base.items() if k not in GROUP_ENV_VARS}
+    env["JAX_COORDINATOR_ADDRESS"] = coordinator
+    env["JAX_NUM_PROCESSES"] = str(int(num_processes))
+    env["JAX_PROCESS_ID"] = str(int(process_id))
+    if devices_per_proc:
+        env["JAX_PLATFORMS"] = "cpu"
+        flag = f"--xla_force_host_platform_device_count={int(devices_per_proc)}"
+        xla_flags = " ".join(
+            part
+            for part in env.get("XLA_FLAGS", "").split()
+            if not part.startswith("--xla_force_host_platform_device_count=")
+        )
+        env["XLA_FLAGS"] = (xla_flags + " " + flag).strip()
+    if coord_timeout_s is not None:
+        env["JAX_COORDINATOR_TIMEOUT_S"] = str(coord_timeout_s)
+    return env
+
 
 def maybe_initialize_multihost() -> bool:
     """Initialize the distributed runtime when configured; returns True when

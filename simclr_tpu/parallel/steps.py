@@ -245,7 +245,33 @@ def check_epoch_compile_preconditions(
     return resident_bytes
 
 
-def _augment_two_views(rng, images, strength, out_size, augment_impl="xla"):
+def _global_sample_keys(rng, n_local: int, views: int = 2):
+    """Per-sample augmentation keys indexed by GLOBAL batch position.
+
+    ``key[v, i] = fold_in(rng, v * N + shard * n_local + i)`` where ``N`` is
+    the global batch — a pure function of the sample's position in the
+    global batch and the view index, NOT of the device layout. An elastic
+    remesh that rescales ``n_local`` while preserving the global batch
+    (supervisor/elastic.py) therefore draws bit-identical augmentation
+    parameters for every sample, and a resumed trajectory tracks an
+    uninterrupted run to within float reduction-order noise. Returned flat
+    ``(views * n_local,)`` key array is view-major — this shard's view-0
+    keys first — matching the ``split(rng, views * n)`` consumption layout.
+    Must run inside the data-axis ``shard_map``.
+    """
+    n_global = n_local * axis_size(DATA_AXIS)
+    rows = jax.lax.axis_index(DATA_AXIS) * n_local + jnp.arange(
+        n_local, dtype=jnp.int32
+    )
+    idx = (
+        jnp.arange(views, dtype=jnp.int32)[:, None] * n_global + rows[None, :]
+    ).reshape(-1)
+    return jax.vmap(lambda i: jax.random.fold_in(rng, i))(idx)
+
+
+def _augment_two_views(
+    rng, images, strength, out_size, augment_impl="xla", keys=None
+):
     """Two on-device SimCLR views of the local uint8 shard.
 
     ``augment_impl="xla"`` is the vmapped per-example chain, converting
@@ -255,12 +281,16 @@ def _augment_two_views(rng, images, strength, out_size, augment_impl="xla"):
     both views from one read of the uint8 tile. Both impls consume the same
     key schedule (``split(rng, 2n)``, first half view 0) and the same
     samplers, so equal seeds draw bit-identical augmentation parameters.
+    The training step passes ``keys`` precomputed by
+    :func:`_global_sample_keys` (same (2n,) layout) so the draw is
+    layout-invariant; ``rng`` is ignored then.
     """
     if augment_impl == "fused":
-        return fused_two_views(rng, images, strength, out_size)
+        return fused_two_views(rng, images, strength, out_size, keys=keys)
     images = to_float(images)
     n = images.shape[0]
-    keys = jax.random.split(rng, 2 * n)
+    if keys is None:
+        keys = jax.random.split(rng, 2 * n)
     aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
     return aug(keys[:n], images, strength, out_size), aug(keys[n:], images, strength, out_size)
 
@@ -357,8 +387,14 @@ def _make_local_pretrain_step(
         )
 
     def local_step(state: TrainState, images: jnp.ndarray, rng: jax.Array):
+        # augmentation keys are global-batch-position-indexed (layout
+        # invariant across an elastic remesh); the quantization stream
+        # below stays per-shard via the shard-folded rng
+        keys = _global_sample_keys(rng, images.shape[0], views=2)
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-        v0, v1 = _augment_two_views(rng, images, strength, out_size, augment_impl)
+        v0, v1 = _augment_two_views(
+            rng, images, strength, out_size, augment_impl, keys=keys
+        )
 
         def loss_fn(params):
             z0, z1, new_stats = apply_views(forward, params, state.batch_stats, v0, v1)
@@ -810,11 +846,13 @@ def _make_local_supervised_step(
     validate_augment_impl(augment_impl)
 
     def local_step(state: TrainState, images, labels, rng):
+        # same global-position key scheme as the pretrain step: the single
+        # view's draw survives an elastic remesh unchanged
+        keys = _global_sample_keys(rng, images.shape[0], views=1)
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
         if augment_impl == "fused":
-            x = fused_one_view(rng, images, strength, out_size)
+            x = fused_one_view(rng, images, strength, out_size, keys=keys)
         else:
-            keys = jax.random.split(rng, images.shape[0])
             aug = jax.vmap(simclr_augment_single, in_axes=(0, 0, None, None))
             x = aug(keys, to_float(images), strength, out_size)
 

@@ -55,6 +55,7 @@ from simclr_tpu.parallel.steps import (
     RESIDENCIES,
     _augment_two_views,
     _forward_fn,
+    _global_sample_keys,
     _local_resident_block,
     _sharded_rows_global_batch,
 )
@@ -159,10 +160,15 @@ def _make_step_body(
     fwd = _forward_fn(local_model, remat)  # the dp step's forward/remat recipe
 
     def local_fwd_bwd(params, batch_stats, images, rng):
-        # the dp step's exact augmentation recipe (steps.py): keys depend on
-        # the DATA shard index only, so model-axis replicas agree
+        # the dp step's exact augmentation recipe (steps.py): keys are
+        # global-batch-position-indexed, so model-axis replicas agree and
+        # the draw survives an elastic remesh; the quant stream below stays
+        # per-data-shard via the shard-folded rng
+        keys = _global_sample_keys(rng, images.shape[0], views=2)
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-        v0, v1 = _augment_two_views(rng, images, strength, out_size, augment_impl)
+        v0, v1 = _augment_two_views(
+            rng, images, strength, out_size, augment_impl, keys=keys
+        )
 
         def loss_fn(p):
             z0, mut = fwd(p, batch_stats, v0)

@@ -46,6 +46,7 @@ from simclr_tpu.parallel.mesh import (
     MODEL_AXIS,
     batch_sharding,
     mesh_from_config,
+    mesh_host_count,
     process_local_rows,
     put_global_batch,
     put_replicated,
@@ -67,6 +68,11 @@ from simclr_tpu.supervisor.guard import (
     RunGuard,
     preempt_checkpoint_name,
     resume_point,
+)
+from simclr_tpu.supervisor.topology import (
+    check_resume_topology,
+    read_topology,
+    write_topology,
 )
 from simclr_tpu.utils.checkpoint import (
     CheckpointCorruptionError,
@@ -170,11 +176,13 @@ def run_supervised(cfg: Config) -> dict:
     # constructed BEFORE the step builders so the compile sentry can watch
     # them. arch=None: the roofline FLOP model covers the pretrain step only,
     # so the supervised MFU gauge honestly reads 0.
+    n_hosts = mesh_host_count(mesh)
     telemetry = Telemetry(
         arch=None,
         per_device_batch=int(cfg.experiment.batches),
         global_batch=global_batch,
         n_devices=jax.device_count(),
+        mesh_hosts=n_hosts,
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
         grad_elements=param_count(state.params),
         allreduce_devices=mesh.shape[DATA_AXIS],
@@ -190,6 +198,7 @@ def run_supervised(cfg: Config) -> dict:
         nan_retry_budget=int(cfg.select("supervisor.nan_retry_budget", 2)),
         telemetry=telemetry,
         events=events,
+        process_index=jax.process_index(),
     )
     # step anomaly detection (obs/anomaly.py): slow-step classifier + stall
     # watchdog + rate-limited auto-trace, host clock reads only
@@ -328,6 +337,9 @@ def run_supervised(cfg: Config) -> dict:
     # so the first post-resume epoch can't spuriously "improve" over None and
     # delete the checkpoint it just resumed from.
     if bool(cfg.select("experiment.resume", False)):
+        # the prior generation's topology record, read before this run
+        # overwrites the sidecar below (elastic remesh accept/reject)
+        prior_topology = read_topology(save_dir)
         t_restore = time.perf_counter()
         restored, ckpt = restore_checkpoint_with_fallback(save_dir, state)
         if restored is not None:
@@ -342,6 +354,27 @@ def run_supervised(cfg: Config) -> dict:
             start_epoch, skip_steps = resume_point(
                 int(state.step), steps_per_epoch
             )
+            # cross-topology resume (elastic remesh): global batch must be
+            # preserved and the checkpoint must sit on an epoch boundary —
+            # same contract as main.py
+            topology_change = check_resume_topology(
+                prior_topology,
+                n_devices=jax.device_count(),
+                n_processes=n_hosts,
+                global_batch=global_batch,
+                skip_steps=skip_steps,
+            )
+            if topology_change is not None:
+                events.emit("topology_change", **topology_change)
+                logger.info(
+                    "Cross-topology resume: %d -> %d devices "
+                    "(%d -> %d hosts), per-device batch now %d",
+                    topology_change["devices_before"],
+                    topology_change["devices_after"],
+                    topology_change["hosts_before"],
+                    topology_change["hosts_after"],
+                    topology_change["per_device_batch"],
+                )
             val_loss, val_acc = run_validation(state)
             telemetry.observe_val_acc(val_acc)
             best_value = val_loss if metric == "loss" else val_acc
@@ -359,6 +392,13 @@ def run_supervised(cfg: Config) -> dict:
                     "Resumed from %s at epoch %d (best %s=%.4f re-validated)",
                     ckpt, start_epoch, metric, best_value,
                 )
+    if is_logging_host():
+        write_topology(
+            save_dir,
+            n_devices=jax.device_count(),
+            n_processes=n_hosts,
+            global_batch=global_batch,
+        )
     if epoch_compile and skip_steps:
         raise ValueError(
             f"checkpoint at step {int(state.step)} is mid-epoch "

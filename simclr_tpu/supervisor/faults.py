@@ -17,11 +17,22 @@ step/epoch boundaries so the e2e tests are reproducible:
   ``SIMCLR_FAULT_CORRUPT_AT_EPOCH=E``  flip a byte in the epoch-E checkpoint
                                        right after it is saved (sidecar left
                                        stale) — the restore fallback path.
+  ``SIMCLR_FAULT_DIE_PROCESS=P:K``     like DIE_AT_STEP=K, but fires only in
+                                       the JAX process with index P — a
+                                       single-host loss on a multi-host run
+                                       (the elastic supervisor's remesh path).
+  ``SIMCLR_FAULT_WEDGE_PROCESS=P:K``   like WEDGE_AT_STEP=K on process P only
+                                       — a single wedged host; its peers keep
+                                       beating for one more step then block
+                                       in the next collective.
 
 Each fault fires ONCE PER RUN DIRECTORY, recorded by a marker file in
 ``save_dir``: a supervisor restart re-executes the same env, and without the
-marker the replayed child would die at the same step forever. Stdlib-only —
-the supervisor runner and tests import this without jax.
+marker the replayed child would die at the same step forever. The
+process-scoped markers live in the same shared ``save_dir``, so a host that
+returns after a remesh does not re-fire. Stdlib-only — the supervisor runner
+and tests import this without jax; the caller passes ``process_index`` in
+(``jax.process_index()`` from the entry points, 0 by default).
 """
 
 from __future__ import annotations
@@ -33,6 +44,8 @@ ENV_DIE = "SIMCLR_FAULT_DIE_AT_STEP"
 ENV_WEDGE = "SIMCLR_FAULT_WEDGE_AT_STEP"
 ENV_NAN = "SIMCLR_FAULT_NAN_AT_STEP"
 ENV_CORRUPT = "SIMCLR_FAULT_CORRUPT_AT_EPOCH"
+ENV_DIE_PROCESS = "SIMCLR_FAULT_DIE_PROCESS"
+ENV_WEDGE_PROCESS = "SIMCLR_FAULT_WEDGE_PROCESS"
 
 # distinct from every meaningful code in the exit-code contract
 # (docs/FAULT_TOLERANCE.md) so a fault-crash never masquerades as a
@@ -47,16 +60,48 @@ def _env_int(name: str) -> int | None:
     return int(raw)
 
 
+def _env_process_step(name: str) -> tuple[int, int] | None:
+    """Parse a process-scoped ``P:K`` fault spec; None when unset. A
+    malformed value raises immediately — a typo'd fault that silently never
+    fires would green-light the very e2e it was meant to drive."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    process, sep, step = raw.partition(":")
+    if not sep:
+        raise ValueError(f"{name} must be 'PROCESS:STEP', got {raw!r}")
+    return int(process), int(step)
+
+
 class FaultPlan:
     """The armed faults for one run directory (all disarmed when the env is
-    clean — the production case; every hook is then a no-op compare)."""
+    clean — the production case; every hook is then a no-op compare).
 
-    def __init__(self, save_dir: str):
+    ``process_index`` scopes the ``*_PROCESS=P:K`` faults: they arm only
+    when it equals P. Passed in by the caller so this module stays
+    stdlib-only (no ``jax.process_index()`` here)."""
+
+    def __init__(self, save_dir: str, process_index: int = 0):
         self.save_dir = save_dir
+        self.process_index = int(process_index)
         self.die_at_step = _env_int(ENV_DIE)
         self.wedge_at_step = _env_int(ENV_WEDGE)
         self.nan_at_step = _env_int(ENV_NAN)
         self.corrupt_at_epoch = _env_int(ENV_CORRUPT)
+        for env, attr in (
+            (ENV_DIE_PROCESS, "die_at_step"),
+            (ENV_WEDGE_PROCESS, "wedge_at_step"),
+        ):
+            scoped = _env_process_step(env)
+            if scoped is not None and scoped[0] == self.process_index:
+                # fold into the same trigger the global fault uses (earliest
+                # wins) so the hooks and markers below need no new paths —
+                # the once-per-run-dir and FAULT_CRASH_CODE contracts hold
+                current = getattr(self, attr)
+                setattr(
+                    self, attr,
+                    scoped[1] if current is None else min(current, scoped[1]),
+                )
 
     # -- once-per-run-dir markers ------------------------------------------
     def _marker(self, kind: str) -> str:

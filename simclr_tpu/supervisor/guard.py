@@ -7,8 +7,10 @@ reflexes the reference never had (save-only checkpoints, SURVEY §5.3-4):
     each step boundary, lands a checkpoint, and the entry point exits with
     the reserved "preempted, resumable" code 75 (EX_TEMPFAIL) — the contract
     the supervisor runner (and any outer orchestrator) keys restart-vs-crash off;
-  * **heartbeat**: process 0 atomically rewrites ``<save_dir>/heartbeat.json``
-    every step so the supervisor can tell a slow step from a wedged one;
+  * **heartbeat**: every process atomically rewrites its own
+    ``<save_dir>/heartbeat[.p<i>].json`` each step so a supervisor can tell
+    a slow step from a wedged one — and a per-host supervisor can tell
+    WHICH host wedged;
   * **non-finite loss**: a NaN/Inf epoch loss rolls the run back to the
     newest sha256-verified checkpoint, with a bounded retry budget before
     the run is declared poisoned (exit 76).
@@ -89,10 +91,15 @@ class RunGuard:
         nan_retry_budget: int = 2,
         telemetry=None,
         events=None,
+        process_index: int = 0,
     ):
         self.save_dir = save_dir
-        self.heartbeat_file = heartbeat_path(save_dir)
-        self.faults = FaultPlan(save_dir)
+        self.process_index = int(process_index)
+        # every process beats into its OWN file (heartbeat.json for process
+        # 0, heartbeat.p<i>.json beyond) so a per-host supervisor can
+        # attribute a wedge to the host that stopped beating first
+        self.heartbeat_file = heartbeat_path(save_dir, self.process_index)
+        self.faults = FaultPlan(save_dir, process_index=self.process_index)
         self.nan_retry_budget = int(nan_retry_budget)
         self.nan_rollbacks = 0
         # optional observability attachments (simclr_tpu/obs/): a Telemetry
@@ -102,7 +109,7 @@ class RunGuard:
         self.events = events
         self._preempt = threading.Event()
         self._previous_handlers: dict[int, object] = {}
-        self._beats = is_logging_host()
+        self._beats = True
 
     def _telemetry_snapshot(self) -> dict | None:
         return self.telemetry.snapshot() if self.telemetry is not None else None
@@ -166,7 +173,7 @@ class RunGuard:
     def after_save(self, epoch: int, checkpoint_path: str) -> None:
         """Post-save hook: the corrupt-latest fault lives here (process 0
         only — it mutates the shared checkpoint files)."""
-        if self._beats:
+        if is_logging_host():
             self.faults.maybe_corrupt(epoch, checkpoint_path)
 
     # -- non-finite-loss guard ---------------------------------------------

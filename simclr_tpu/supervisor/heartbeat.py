@@ -1,8 +1,14 @@
 """Heartbeat file — the liveness channel between a run and its supervisor.
 
-The training process (process 0 only) rewrites one small JSON file at every
-host-loop step boundary; the supervisor runner tails it to tell "slow" from
-"wedged" (``runner.py``). The write is an atomic rename so a reader never
+Every training process rewrites one small JSON file at every host-loop step
+boundary — ``heartbeat.json`` for process 0 (the path every pre-elastic
+reader knows), ``heartbeat.p<i>.json`` for process ``i>0`` — and a
+supervisor tails them to tell "slow" from "wedged" (``runner.py`` watches
+process 0; the elastic supervisor watches one per host to attribute a wedge
+to the host whose file went stale FIRST — the wedge fires before the beat
+write, so the culprit's last beat is one step older than its peers', which
+beat once more and then block in the next collective).
+The write is an atomic rename so a reader never
 sees a torn file, but deliberately does NOT fsync: a heartbeat is a liveness
 signal, not a durable artifact — losing the last beat in a power cut is
 indistinguishable from dying one step earlier, while an fsync per step would
@@ -28,9 +34,15 @@ STATUS_RUNNING = "running"
 STATUS_PREEMPTED = "preempted"
 
 
-def heartbeat_path(save_dir: str) -> str:
+def heartbeat_path(save_dir: str, process_index: int = 0) -> str:
     """The run's heartbeat file, fixed relative to ``save_dir`` so the
-    supervisor can find it without any channel to the child but argv."""
+    supervisor can find it without any channel to the child but argv.
+
+    Process 0 keeps the historical ``heartbeat.json`` name (the runner, the
+    report tool, and operators' ``watch cat`` all read it); process ``i>0``
+    gets ``heartbeat.p<i>.json``, one liveness file per host."""
+    if process_index:
+        return os.path.join(save_dir, f"heartbeat.p{int(process_index)}.json")
     return os.path.join(save_dir, HEARTBEAT_NAME)
 
 

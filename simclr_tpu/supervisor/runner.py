@@ -68,6 +68,7 @@ class SupervisorKnobs:
 
     max_restarts: int = 8
     backoff_base_s: float = 5.0
+    backoff_max_s: float = 300.0
     heartbeat_timeout_factor: float = 10.0
     heartbeat_min_timeout_s: float = 30.0
     startup_grace_s: float = 600.0
@@ -79,6 +80,9 @@ class SupervisorKnobs:
             max_restarts=int(cfg.select("supervisor.max_restarts", d.max_restarts)),
             backoff_base_s=float(
                 cfg.select("supervisor.backoff_base_s", d.backoff_base_s)
+            ),
+            backoff_max_s=float(
+                cfg.select("supervisor.backoff_max_s", d.backoff_max_s)
             ),
             heartbeat_timeout_factor=float(
                 cfg.select(
@@ -94,6 +98,18 @@ class SupervisorKnobs:
                 cfg.select("supervisor.startup_grace_s", d.startup_grace_s)
             ),
         )
+
+
+def backoff_delay(knobs: SupervisorKnobs, prior_restarts: int) -> float:
+    """Exponential restart delay, capped at ``supervisor.backoff_max_s``.
+
+    Uncapped doubling from ``backoff_base_s`` reaches hours by restart 12
+    and days by 15 — a run with a generous budget would spend its life
+    sleeping. Shared with the elastic supervisor's per-host re-admission
+    cooldown so both policies cap identically."""
+    return min(
+        knobs.backoff_base_s * (2.0 ** prior_restarts), knobs.backoff_max_s
+    )
 
 
 class _BeatTracker:
@@ -299,7 +315,7 @@ def supervise(
                 exit_code = rc if 0 < rc < 256 else 1
                 return _summary(OUTCOME_CRASHED, exit_code)
             restarts[kind] += 1
-            backoff = knobs.backoff_base_s * (2.0 ** total)
+            backoff = backoff_delay(knobs, total)
             events.emit(
                 "restart", attempt=attempt, kind=kind, exit=rc,
                 backoff_s=backoff, restart=total + 1,

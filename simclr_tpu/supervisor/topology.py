@@ -1,0 +1,114 @@
+"""The run-dir topology sidecar: cross-topology resume accept/reject.
+
+Elastic remeshing (``supervisor/elastic.py``) restarts a run on a DIFFERENT
+device count than the one that wrote its checkpoints. The checkpoint layer
+already handles the array mechanics (orbax restores into whatever shardings
+the current mesh's restore template carries), but two run-level invariants
+must be checked by the entry points themselves, and that needs a record of
+the topology that wrote the run:
+
+* the GLOBAL batch must be preserved — it fixes steps/epoch and with it the
+  per-step RNG schedule (which folds on the absolute step index); a changed
+  global batch silently forks the trajectory, so it is a hard error;
+* a topology change is only coherent at an EPOCH boundary — a mid-epoch
+  checkpoint's partial-epoch replay is defined in terms of the old per-device
+  batch layout, so cross-topology + ``skip_steps > 0`` is rejected loudly.
+
+``topology.json`` {n_devices, n_processes, global_batch} is written by the
+logging host at every run start (after the resume check reads the PRIOR
+generation's copy). Stdlib-only: callers pass the current topology in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+TOPOLOGY_NAME = "topology.json"
+
+
+def topology_path(save_dir: str) -> str:
+    return os.path.join(save_dir, TOPOLOGY_NAME)
+
+
+def read_topology(save_dir: str) -> dict | None:
+    """The previous generation's topology record, or None (fresh run dir, or
+    a run dir from before this sidecar existed — both resume unchecked, same
+    as the historical behavior)."""
+    try:
+        with open(topology_path(save_dir), encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def write_topology(
+    save_dir: str, *, n_devices: int, n_processes: int, global_batch: int
+) -> None:
+    """Record the CURRENT topology (atomic: a crash mid-write must not leave
+    a half sidecar to poison the next resume's check)."""
+    from simclr_tpu.utils.ioutil import atomic_write
+
+    os.makedirs(save_dir, exist_ok=True)
+    payload = {
+        "n_devices": int(n_devices),
+        "n_processes": int(n_processes),
+        "global_batch": int(global_batch),
+    }
+    atomic_write(
+        topology_path(save_dir),
+        lambda f: json.dump(payload, f, sort_keys=True),
+    )
+
+
+def check_resume_topology(
+    prior: dict | None,
+    *,
+    n_devices: int,
+    n_processes: int,
+    global_batch: int,
+    skip_steps: int,
+) -> dict | None:
+    """Accept or reject a resume onto the current topology.
+
+    Returns None when the topology is unchanged (or no prior record exists),
+    or a change dict ``{devices_before, devices_after, hosts_before,
+    hosts_after, per_device_batch}`` when the device count changed and the
+    resume is ACCEPTED — the caller logs it and emits a ``topology_change``
+    event. Raises ``ValueError`` for the two rejections described in the
+    module docstring.
+    """
+    if prior is None:
+        return None
+    try:
+        prior_devices = int(prior.get("n_devices"))
+        prior_processes = int(prior.get("n_processes", 1))
+        prior_global = int(prior.get("global_batch"))
+    except (TypeError, ValueError):
+        return None  # unreadable sidecar: treat like a pre-sidecar run dir
+    if prior_global != int(global_batch):
+        raise ValueError(
+            f"resume changes the GLOBAL batch ({prior_global} -> "
+            f"{global_batch}); that forks steps/epoch and the per-step RNG "
+            "schedule, so it cannot continue this run's trajectory. An "
+            "elastic remesh must rescale experiment.batches so "
+            "per_device x devices stays constant."
+        )
+    if prior_devices == int(n_devices):
+        return None
+    if int(skip_steps) > 0:
+        raise ValueError(
+            f"checkpoint is mid-epoch ({skip_steps} steps in) and the device "
+            f"count changed ({prior_devices} -> {n_devices}); partial-epoch "
+            "replay is defined in terms of the old per-device layout, so a "
+            "cross-topology resume is only accepted at epoch boundaries — "
+            "restart from the last epoch-boundary checkpoint"
+        )
+    return {
+        "devices_before": prior_devices,
+        "devices_after": int(n_devices),
+        "hosts_before": prior_processes,
+        "hosts_after": int(n_processes),
+        "per_device_batch": int(global_batch) // max(int(n_devices), 1),
+    }

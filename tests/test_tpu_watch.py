@@ -20,9 +20,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
-    "allreduce_bench", "augment_bench", "multihost_dryrun", "remat2048",
-    "explore1024", "explore512", "supervisor_smoke", "obs_smoke",
-    "compile_audit", "superepoch", "serve_scale", "run_report",
+    "allreduce_bench", "augment_bench", "multihost_dryrun",
+    "elastic_dryrun", "remat2048", "explore1024", "explore512",
+    "supervisor_smoke", "obs_smoke", "compile_audit", "superepoch",
+    "serve_scale", "run_report",
 )
 
 
@@ -86,10 +87,21 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         '{"xla": {"ms_per_batch": 2.2, "hbm_mb": 7.5}, '
         '"fused": {"ms_per_batch": 0.9, "hbm_mb": 2.256}}}}}\';; esac',
         # the multihost_dryrun stage greps its stdout for a 2-process
-        # parity payload (its orchestrator also exits 0 on error)
-        'case "$*" in *multihost_dryrun.py*) '
+        # parity payload (its orchestrator also exits 0 on error); the
+        # pattern anchors on the argv END so the --elastic invocation
+        # below is NOT double-matched
+        'case "$*" in *multihost_dryrun.py) '
         'echo \'{"metric": "multihost_dryrun_parity", "value": 1.0, '
         '"unit": "bool", "process_count": 2, "parity": true}\';; esac',
+        # the elastic_dryrun stage shares the orchestrator script but
+        # passes --elastic; its done marker demands a clean supervisor
+        # outcome with at least one remesh, trajectory parity, and no
+        # error field (the script also exits 0 on error)
+        'case "$*" in *multihost_dryrun.py\\ --elastic) '
+        'echo \'{"metric": "elastic_dryrun", "value": 1.0, '
+        '"unit": "bool", "outcome": "clean", "remesh_count": 2, '
+        '"grow_back_count": 1, "hosts": [2, 1, 2], '
+        '"parity": true, "max_loss_delta": 0.012}\';; esac',
         # the supervisor_smoke stage greps its stdout for a clean outcome
         # with at least one resume (an uncrashed run also exits 0)
         'case "$*" in *simclr_tpu.supervisor*) '
@@ -309,6 +321,47 @@ def test_multihost_marker_requires_two_process_parity(tmp_path):
     r, state, log = _run_oneshot(tmp_path)
     assert "multihost_dryrun" not in _done(state)
     assert (state / "multihost_dryrun.fails").exists()
+
+
+def test_elastic_marker_requires_clean_outcome_with_a_remesh(tmp_path):
+    """The elastic orchestrator exits 0 even on failure, so the done marker
+    must demand the full claim: a CLEAN supervisor outcome AND at least one
+    remesh AND trajectory parity. A clean run where the injected host kill
+    never fired (remesh_count 0) proves nothing about elasticity."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"remesh_count": 2, "grow_back_count": 1',
+        '"remesh_count": 0, "grow_back_count": 0'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "elastic_dryrun" not in _done(state)
+    assert (state / "elastic_dryrun.fails").exists()
+    assert "stage elastic_dryrun FAILED" in log.read_text()
+    # the plain parity dryrun sharing the script must be untouched
+    assert "multihost_dryrun" in _done(state)
+
+    # second contract: remeshed but the post-remesh trajectory diverged
+    # from the uninterrupted same-seed reference
+    stub.write_text(stub.read_text()
+                    .replace('"remesh_count": 0, "grow_back_count": 0',
+                             '"remesh_count": 2, "grow_back_count": 1')
+                    .replace('"parity": true, "max_loss_delta": 0.012',
+                             '"parity": false, "max_loss_delta": 0.31'))
+    (state / "elastic_dryrun.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "elastic_dryrun" not in _done(state)
+    assert (state / "elastic_dryrun.fails").exists()
+
+    # third contract: the last-ditch error payload also exits 0
+    stub.write_text(stub.read_text()
+                    .replace('"parity": false, "max_loss_delta": 0.31',
+                             '"parity": true, "max_loss_delta": 0.012')
+                    .replace('"outcome": "clean"',
+                             '"outcome": "crashed", "error": "budget"'))
+    (state / "elastic_dryrun.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "elastic_dryrun" not in _done(state)
+    assert (state / "elastic_dryrun.fails").exists()
 
 
 def test_supervisor_marker_requires_an_actual_resume(tmp_path):

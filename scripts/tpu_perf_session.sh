@@ -10,4 +10,15 @@
 set -u
 LOG="${1:-/tmp/perf_matrix.log}"
 cd "$(dirname "$0")/.."
+# async-collective XLA flags (parallel/mesh.py ASYNC_COLLECTIVE_XLA_FLAGS):
+# let the latency-hiding scheduler hide comm_overlap=async ring hops in the
+# overlap_async stage; harmless for the other stages (scheduling flags only)
+export XLA_FLAGS="${XLA_FLAGS:-} \
+--xla_tpu_enable_async_collective_fusion=true \
+--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true \
+--xla_tpu_enable_async_collective_fusion_multiple_steps=true \
+--xla_enable_async_collective_permute=true \
+--xla_enable_async_all_gather=true \
+--xla_tpu_overlap_compute_collective_tc=true \
+--xla_tpu_enable_latency_hiding_scheduler=true"
 TPU_WATCH_ONESHOT=1 exec bash scripts/tpu_watch.sh "$LOG" "$(mktemp -d)"

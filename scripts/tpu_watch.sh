@@ -54,7 +54,7 @@ MAX_STAGE_FAILS=3
 # chip lock — proves the pod code path on the host), then the remaining
 # step matrices, and last the supervisor kill/resume smoke (fault
 # tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench augment_bench multihost_dryrun elastic_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench overlap_async augment_bench multihost_dryrun elastic_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -199,6 +199,30 @@ run_stage() {
             if [ "$rc" -eq 0 ]; then
                 grep -q '"metric": "allreduce_wire_reduction' "$out" \
                     && grep -q '"overlap"' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        overlap_async)
+            # comm_overlap=async evidence (scripts/allreduce_bench.py
+            # --overlap-async): the eager per-bucket rings issued under the
+            # staged backward, with the MEASURED exposed-comm column next
+            # to the single-shot baseline. The done marker requires an
+            # error-free payload WITH an async table AND gradient parity
+            # with the single-shot path ("async_matches_off": true — the
+            # same-dequantized-gradient invariant, measured on hardware)
+            # AND zero post-warmup recompiles (a schedule whose signature
+            # churns mid-bench would alarm CompileSentry in training).
+            out="$STATE/overlap_async.out"
+            run_locked "$(stage_timeout 900)" python scripts/allreduce_bench.py \
+                --overlap-async > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"metric": "allreduce_wire_reduction' "$out" \
+                    && grep -q '"overlap_async"' "$out" \
+                    && grep -q '"async_matches_off": true' "$out" \
+                    && ! grep -q '"async_matches_off": false' "$out" \
+                    && grep -q '"recompile_alarms": 0' "$out" \
                     && ! grep -q '"error"' "$out"
                 rc=$?
             fi ;;

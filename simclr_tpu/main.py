@@ -50,6 +50,7 @@ from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
+    enable_async_collective_flags,
     mesh_from_config,
     mesh_host_count,
     put_replicated,
@@ -111,6 +112,14 @@ def run_pretrain(cfg: Config) -> dict:
     check_pretrain_conf(cfg)
     seed = int(cfg.parameter.seed)
 
+    comm_overlap = str(
+        normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
+    )
+    comm_chunks = int(cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS))
+    if comm_overlap == "async":
+        # must land in XLA_FLAGS before mesh_from_config initializes the
+        # backend; no-op off-TPU (parallel/mesh.py)
+        enable_async_collective_flags()
     mesh = mesh_from_config(cfg)
     n_data = mesh.shape[DATA_AXIS]
     global_batch = validate_per_device_batch(int(cfg.experiment.batches), mesh)
@@ -193,6 +202,8 @@ def run_pretrain(cfg: Config) -> dict:
         grad_elements=param_count(state.params),
         allreduce_devices=n_data,
         augment_impl=str(cfg.select("runtime.augment_impl", "xla")),
+        comm_overlap=comm_overlap,
+        comm_chunks=comm_chunks,
     )
     events = EventLog(
         save_dir,
@@ -303,13 +314,10 @@ def run_pretrain(cfg: Config) -> dict:
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
         # parallel.comm_overlap / comm_chunks: collective schedule — "chunked"
         # splits the all-reduce into N ppermute rings XLA overlaps with the
-        # backward (docs/PERF.md §"Overlapped collectives")
-        comm_overlap=str(
-            normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
-        ),
-        comm_chunks=int(
-            cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
-        ),
+        # backward; "async" issues those rings eagerly under the staged
+        # backward (docs/PERF.md §"Async overlapped backward")
+        comm_overlap=comm_overlap,
+        comm_chunks=comm_chunks,
         # runtime.augment_impl: xla | fused — fused runs both views through
         # the Pallas one-VMEM-pass kernel (ops/augment_pallas.py,
         # docs/PERF.md §"Fused augmentation")
@@ -416,9 +424,10 @@ def run_pretrain(cfg: Config) -> dict:
             make_pretrain_step_tp,
         )
 
+        # every loss.negatives/loss.fused variant now threads through the tp
+        # builders with the dp path's dispatch (parallel/tp.py); only the
+        # forward-mode restriction remains
         unsupported = {
-            "loss.fused": step_kwargs["fused"],
-            "loss.negatives != global": step_kwargs["negatives"] != "global",
             "model.forward_mode != two_pass": step_kwargs["forward_mode"] != "two_pass",
         }
         bad = [k for k, v in unsupported.items() if v]
@@ -445,6 +454,8 @@ def run_pretrain(cfg: Config) -> dict:
                 model, tx, mesh,
                 temperature=step_kwargs["temperature"],
                 strength=step_kwargs["strength"],
+                negatives=step_kwargs["negatives"],
+                fused=step_kwargs["fused"],
                 remat=step_kwargs["remat"],
                 residency=residency,
                 grad_allreduce=step_kwargs["grad_allreduce"],
@@ -467,6 +478,8 @@ def run_pretrain(cfg: Config) -> dict:
                     model, tx, mesh,
                     temperature=step_kwargs["temperature"],
                     strength=step_kwargs["strength"],
+                    negatives=step_kwargs["negatives"],
+                    fused=step_kwargs["fused"],
                     remat=step_kwargs["remat"],
                     residency=residency,
                     grad_allreduce=step_kwargs["grad_allreduce"],
@@ -496,6 +509,8 @@ def run_pretrain(cfg: Config) -> dict:
                 model, tx, mesh,
                 temperature=step_kwargs["temperature"],
                 strength=step_kwargs["strength"],
+                negatives=step_kwargs["negatives"],
+                fused=step_kwargs["fused"],
                 remat=step_kwargs["remat"],
                 grad_allreduce=step_kwargs["grad_allreduce"],
                 comm_overlap=step_kwargs["comm_overlap"],

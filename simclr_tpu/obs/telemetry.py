@@ -101,6 +101,8 @@ class Telemetry:
         grad_elements: int | None = None,
         allreduce_devices: int | None = None,
         augment_impl: str = "xla",
+        comm_overlap: str = "off",
+        comm_chunks: int = 1,
         peak_flops: float = PEAK_FLOPS,
     ):
         self.global_batch = int(global_batch)
@@ -190,7 +192,14 @@ class Telemetry:
             "Fractional drift of the roofline FLOP model feeding the live "
             "MFU gauge vs XLA's analytic cost for the step executable "
             "(roofline/xla - 1; 0 until a step cost is recorded)")
+        self.exposed_comm_ms = Gauge(
+            "simclr_train_exposed_comm_ms",
+            "Step wall time in excess of the roofline compute time, in ms — "
+            "the communication the scheduler did NOT hide (0 when no roofline "
+            "model applies; compare across comm_overlap=off|chunked|async)")
         self.grad_allreduce_mode = str(grad_allreduce)
+        self.comm_overlap = str(comm_overlap)
+        self.comm_chunks = int(comm_chunks)
         # name -> (flops/step, bytes/step) from the compile sentry, rendered
         # as labeled per-executable cost gauges
         self._xla_costs: dict[str, tuple[float, float]] = {}
@@ -204,6 +213,8 @@ class Telemetry:
                     int(grad_elements),
                     allreduce_devices or self.n_devices,
                     self.grad_allreduce_mode,
+                    overlap=self.comm_overlap,
+                    chunks=self.comm_chunks,
                 )
             )
         self._metrics = (
@@ -215,6 +226,7 @@ class Telemetry:
             self.anomaly_slow_steps, self.anomaly_stalls, self.auto_traces,
             self.scrape_disconnects, self.compiles, self.compile_seconds,
             self.recompile_alarms, self.mesh_hosts, self.mfu_xla_drift,
+            self.exposed_comm_ms,
         )
         self._started = time.time()
 
@@ -258,6 +270,13 @@ class Telemetry:
         self.imgs_per_sec_per_chip.set(rate / self.n_devices)
         if self.flops_per_step:
             self.mfu.set(self.flops_per_step / (step_time * self.peak_flops))
+            # what the step spent beyond roofline compute: at 100% overlap
+            # this tends to 0, and the off->chunked->async deltas attribute
+            # exactly how much of the ring the scheduler hid
+            self.exposed_comm_ms.set(
+                max(0.0, step_time - self.flops_per_step / self.peak_flops)
+                * 1000.0
+            )
 
     def observe_save(self, seconds: float) -> None:
         self.checkpoint_save_seconds.observe(float(seconds))
@@ -320,6 +339,7 @@ class Telemetry:
             "imgs_per_sec": self.imgs_per_sec.value,
             "imgs_per_sec_per_chip": self.imgs_per_sec_per_chip.value,
             "mfu": self.mfu.value,
+            "exposed_comm_ms": self.exposed_comm_ms.value,
             "slow_steps": self.anomaly_slow_steps.value,
             "stalls": self.anomaly_stalls.value,
             "auto_traces": self.auto_traces.value,

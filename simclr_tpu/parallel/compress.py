@@ -69,6 +69,18 @@ unmodified single-shot code paths — bitwise-identical to PR 4's behavior.
 The gather phase forwards each reduced segment's bytes unchanged, so every
 device dequantizes identical payloads and the replica-bitwise-identical
 invariant survives chunking.
+
+``comm_overlap=async`` goes one step further: the same ring decomposition
+with the same per-chunk key schedule, but each bucket is assembled from only
+the gradient leaves it spans (no global concatenate) and the rings are
+issued in reverse bucket order — the order reverse-mode AD materializes
+cotangents — so a tail bucket's wire hops are data-independent of the head
+layers' backward matmuls and can be hidden under them (paired with the
+staged backward in ``parallel/steps.py`` and the async-collective XLA flags
+from ``parallel/mesh.py``). Because the bucket boundaries, wire format, and
+``fold_in(key, chunk_idx)`` schedule are identical to ``chunked``, ``async``
+hands LARS the *same dequantized gradient* (bitwise under int8) — only the
+schedule changes.
 """
 
 from __future__ import annotations
@@ -90,8 +102,11 @@ WEIGHT_QUANT_MODES = ("exact", "bf16", "int8")
 
 # overlap strategy for the gradient all-reduce: "off" is the single-shot
 # fused-collective path (bitwise-identical to PR 4), "chunked" decomposes it
-# into parallel.comm_chunks independent ppermute rings XLA can overlap
-COMM_OVERLAP_MODES = ("off", "chunked")
+# into parallel.comm_chunks independent ppermute rings XLA can overlap, and
+# "async" additionally assembles each ring's bucket from only the leaves it
+# spans — issued eagerly (last layers first) so the rings are data-ready
+# while earlier layers' backward matmuls are still in flight
+COMM_OVERLAP_MODES = ("off", "chunked", "async")
 
 # default chunk count for comm_overlap=chunked: enough independent rings to
 # hide wire latency under compute without shrinking messages below the
@@ -270,12 +285,14 @@ def allreduce_wire_bytes(
     whole buckets per segment) and pays the same ``2 * (n-1)/n`` phase
     fraction on its padded payload — per-chunk padding is the only analytic
     cost of chunking, and it shrinks to zero at real gradient sizes.
+    ``overlap="async"`` ships the exact same rings (the schedule, not the
+    wire format, changes), so it shares the chunked accounting.
     """
     validate_mode(mode)
-    validate_overlap(overlap, chunks if overlap == "chunked" else None)
+    validate_overlap(overlap, chunks if overlap != "off" else None)
     n = max(int(n_devices), 1)
     phase_fraction = 2.0 * (n - 1) / n
-    if overlap == "chunked":
+    if overlap != "off":
         total = 0.0
         for start, stop in _chunk_bounds(int(n_elements), int(chunks)):
             sz = stop - start
@@ -462,7 +479,7 @@ def grad_allreduce(
     the next chunk's quant/dequant compute.
     """
     validate_mode(mode)
-    validate_overlap(overlap, chunks if overlap == "chunked" else None)
+    validate_overlap(overlap, chunks if overlap != "off" else None)
     if overlap == "off":
         if mode == "exact":
             return jax.lax.psum(grads, axis_name)
@@ -489,16 +506,59 @@ def grad_allreduce(
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:
         return grads
-    flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves])
-    pieces = []
-    for c, (start, stop) in enumerate(_chunk_bounds(flat.shape[0], chunks)):
-        ck = jax.random.fold_in(key, c) if key is not None else None
-        pieces.append(
-            _ring_chunk_allreduce(flat[start:stop], axis_name, mode, ck, bucket_size)
-        )
-    summed = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
-    out, offset = [], 0
+    if overlap == "chunked":
+        flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves])
+        pieces = []
+        for c, (start, stop) in enumerate(_chunk_bounds(flat.shape[0], chunks)):
+            ck = jax.random.fold_in(key, c) if key is not None else None
+            pieces.append(
+                _ring_chunk_allreduce(flat[start:stop], axis_name, mode, ck, bucket_size)
+            )
+        summed = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+        out, offset = [], 0
+        for l in leaves:
+            out.append(summed[offset:offset + l.size].reshape(l.shape).astype(l.dtype))
+            offset += l.size
+        return jax.tree.unflatten(treedef, out)
+
+    # async: the chunked branch's global concatenate makes EVERY ring depend
+    # on ALL cotangents, which serializes the collective tail after the full
+    # backward. Here each bucket (same _chunk_bounds boundaries over the same
+    # leaf-order flat layout, same fold_in(key, c) schedule — so the reduced
+    # values are identical to chunked, bitwise under int8) is assembled from
+    # ONLY the leaf slices it spans, and the rings are issued in reverse
+    # bucket order: under reverse-mode AD the LAST layers' cotangents
+    # materialize first, so the tail buckets' rings are data-ready while the
+    # first layers' backward matmuls are still running — genuine
+    # data-independence for XLA's latency-hiding scheduler.
+    offsets, off = [], 0
     for l in leaves:
-        out.append(summed[offset:offset + l.size].reshape(l.shape).astype(l.dtype))
-        offset += l.size
+        offsets.append(off)
+        off += l.size
+    bounds = _chunk_bounds(off, chunks)
+    reduced = [None] * len(bounds)
+    for c in reversed(range(len(bounds))):
+        start, stop = bounds[c]
+        parts = []
+        for l, loff in zip(leaves, offsets):
+            lo, hi = max(start, loff), min(stop, loff + l.size)
+            if lo < hi:
+                parts.append(
+                    l.reshape(-1)[lo - loff:hi - loff].astype(jnp.float32)
+                )
+        bucket = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        ck = jax.random.fold_in(key, c) if key is not None else None
+        reduced[c] = _ring_chunk_allreduce(bucket, axis_name, mode, ck, bucket_size)
+    out = []
+    for l, loff in zip(leaves, offsets):
+        pieces = []
+        for (start, stop), r in zip(bounds, reduced):
+            lo, hi = max(start, loff), min(stop, loff + l.size)
+            if lo < hi:
+                pieces.append(r[lo - start:hi - start])
+        if not pieces:
+            out.append(l)  # zero-size leaf: nothing was reduced
+            continue
+        piece = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+        out.append(piece.reshape(l.shape).astype(l.dtype))
     return jax.tree.unflatten(treedef, out)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Sequence
 
 import jax
@@ -27,6 +28,51 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+# XLA flags that let the latency-hiding scheduler actually hide the async
+# ring hops emitted by comm_overlap=async: async lowering of the collective
+# primitives the ring uses (ppermute, all-gather, all-reduce) plus the
+# scheduler itself. TPU-only — CPU/GPU jaxlib rejects unknown --xla_tpu_*
+# flags as fatal, so enable_async_collective_flags() gates on the platform.
+ASYNC_COLLECTIVE_XLA_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+)
+
+
+def enable_async_collective_flags(env=None, *, platform: str | None = None) -> bool:
+    """Append :data:`ASYNC_COLLECTIVE_XLA_FLAGS` to ``XLA_FLAGS`` (idempotent).
+
+    Must run BEFORE the jax backend initializes — which is why the platform
+    check reads the environment (``JAX_PLATFORMS`` / ``TPU_NAME`` /
+    ``TPU_WORKER_ID``) instead of ``jax.default_backend()``: asking jax for
+    the backend would initialize it and freeze ``XLA_FLAGS`` too early.
+    Returns True when the flags are (already) in effect, False when skipped
+    off-TPU. ``env``/``platform`` exist for tests.
+    """
+    env = os.environ if env is None else env
+    if platform is None:
+        declared = env.get("JAX_PLATFORMS", "") or env.get("JAX_PLATFORM_NAME", "")
+        if "tpu" in declared.lower():
+            platform = "tpu"
+        elif declared:
+            platform = declared.split(",")[0].strip().lower()
+        elif env.get("TPU_NAME") or env.get("TPU_WORKER_ID"):
+            platform = "tpu"
+        else:
+            platform = "unknown"
+    if platform != "tpu":
+        return False
+    current = env.get("XLA_FLAGS", "")
+    missing = [f for f in ASYNC_COLLECTIVE_XLA_FLAGS if f not in current]
+    if missing:
+        env["XLA_FLAGS"] = " ".join(filter(None, [current, *missing]))
+    return True
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):

@@ -366,8 +366,11 @@ def _make_local_pretrain_step(
     optimizer the identical dequantized gradient. ``comm_overlap``/
     ``comm_chunks`` pick the collective schedule: ``chunked`` decomposes the
     all-reduce into independent ppermute rings XLA can overlap with the
-    backward's tail compute; ``off`` is bitwise-identical to the single-shot
-    path.
+    backward's tail compute; ``async`` additionally stages the backward as an
+    explicit VJP and assembles each ring's bucket from only the leaves it
+    spans, so tail buckets' rings issue while head layers' backward matmuls
+    are still running (same dequantized gradient as ``chunked``, bitwise
+    under int8); ``off`` is bitwise-identical to the single-shot path.
     """
     compress.validate_mode(grad_allreduce)
     compress.validate_overlap(comm_overlap, comm_chunks)
@@ -412,7 +415,16 @@ def _make_local_pretrain_step(
                 loss = ntxent_loss_local_negatives(z0, z1, DATA_AXIS, temperature)
             return loss, new_stats
 
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        if comm_overlap == "async":
+            # staged backward: explicit VJP makes the cotangent pytree a
+            # first-class value whose leaves the scheduler sees individually;
+            # paired with grad_allreduce's per-bucket assembly (no global
+            # concatenate) each ring depends only on the leaves it spans, so
+            # its hops can issue while earlier layers' backward matmuls run
+            loss, vjp_fn, new_stats = jax.vjp(loss_fn, state.params, has_aux=True)
+            grads, = vjp_fn(jnp.ones_like(loss))
+        else:
+            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
         # the quantization stream forks off the same per-step, per-data-shard
         # rng the augmentations use (fold_in is the jax stream-split idiom)
         grads = compress.grad_allreduce(
@@ -868,9 +880,16 @@ def _make_local_supervised_step(
             correct = jnp.sum(jnp.argmax(logits, -1) == labels)
             return loss, (mut["batch_stats"], correct, per_example.shape[0])
 
-        (loss, (new_stats, correct, n_local)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        if comm_overlap == "async":
+            # staged backward, same shape as the pretrain step's async path
+            loss, vjp_fn, (new_stats, correct, n_local) = jax.vjp(
+                loss_fn, state.params, has_aux=True
+            )
+            grads, = vjp_fn(jnp.ones_like(loss))
+        else:
+            (loss, (new_stats, correct, n_local)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
         grads = compress.grad_allreduce(
             grads, DATA_AXIS, grad_allreduce,
             key=jax.random.fold_in(rng, compress.KEY_FOLD_QUANT),

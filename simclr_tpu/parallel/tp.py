@@ -48,7 +48,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from simclr_tpu.models.resnet import feature_dim
 from simclr_tpu.ops.augment_pallas import validate_impl as validate_augment_impl
-from simclr_tpu.ops.ntxent import ntxent_loss_sharded_rows
+from simclr_tpu.ops.ntxent import (
+    ntxent_loss_local_negatives,
+    ntxent_loss_sharded_rows,
+)
+from simclr_tpu.ops.ntxent_pallas import (
+    ntxent_loss_fused,
+    ntxent_loss_fused_sharded,
+)
+from simclr_tpu.ops.ntxent_ring import ntxent_loss_ring
 from simclr_tpu.parallel import compress
 from simclr_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, axis_size, shard_map
 from simclr_tpu.parallel.steps import (
@@ -131,6 +139,8 @@ def _make_step_body(
     temperature: float,
     strength: float,
     out_size: int,
+    negatives: str = "global",
+    fused: bool = False,
     remat: bool = False,
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
@@ -151,10 +161,22 @@ def _make_step_body(
     ``comm_overlap``/``comm_chunks`` likewise apply to the data-axis ring
     only — each ppermute ring runs within a model-axis replica's data ring,
     and the gather phase forwards bytes verbatim, so model-axis replicas
-    still dequantize identical gradients."""
+    still dequantize identical gradients.
+
+    ``negatives``/``fused`` select the NT-Xent variant with the dp path's
+    exact dispatch (``steps._make_local_pretrain_step``) — the loss operates
+    on the per-data-shard embeddings the TP head psum-completes, so every
+    data-axis variant composes with head sharding unchanged."""
     compress.validate_mode(grad_allreduce)
     compress.validate_overlap(comm_overlap, comm_chunks)
     validate_augment_impl(augment_impl)
+    if negatives not in ("global", "local", "ring"):
+        raise ValueError(f"negatives must be global|local|ring, got {negatives!r}")
+    if fused and negatives == "ring":
+        raise ValueError(
+            "loss.fused does not combine with negatives='ring' (the ring loss "
+            "is already blockwise); use negatives='global' with fused"
+        )
     tp = mesh.shape[MODEL_AXIS]
     local_model = _local_view(model, tp)
     fwd = _forward_fn(local_model, remat)  # the dp step's forward/remat recipe
@@ -173,10 +195,28 @@ def _make_step_body(
         def loss_fn(p):
             z0, mut = fwd(p, batch_stats, v0)
             z1, mut = fwd(p, mut["batch_stats"], v1)
-            loss = ntxent_loss_sharded_rows(z0, z1, DATA_AXIS, temperature)
+            if fused and negatives == "global":
+                loss = ntxent_loss_fused_sharded(z0, z1, DATA_AXIS, temperature)
+            elif fused:  # local negatives, per-shard fused kernel
+                loss = jax.lax.pmean(
+                    ntxent_loss_fused(z0, z1, temperature), DATA_AXIS
+                )
+            elif negatives == "global":
+                loss = ntxent_loss_sharded_rows(z0, z1, DATA_AXIS, temperature)
+            elif negatives == "ring":
+                loss = ntxent_loss_ring(z0, z1, DATA_AXIS, temperature)
+            else:
+                loss = ntxent_loss_local_negatives(z0, z1, DATA_AXIS, temperature)
             return loss, mut["batch_stats"]
 
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if comm_overlap == "async":
+            # staged backward (see steps._make_local_pretrain_step): explicit
+            # VJP + per-bucket ring assembly in grad_allreduce lets tail
+            # buckets' data-axis rings issue under earlier backward matmuls
+            loss, vjp_fn, new_stats = jax.vjp(loss_fn, params, has_aux=True)
+            grads, = vjp_fn(jnp.ones_like(loss))
+        else:
+            (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         # same convention as steps.py: sum over the data axis (compressed
         # per grad_allreduce), BEFORE the jit-level LARS update below
         grads = compress.grad_allreduce(
@@ -224,6 +264,8 @@ def make_pretrain_step_tp(
     temperature: float = 0.5,
     strength: float = 0.5,
     out_size: int = 32,
+    negatives: str = "global",
+    fused: bool = False,
     remat: bool = False,
     grad_allreduce: str = "exact",
     comm_overlap: str = "off",
@@ -231,7 +273,8 @@ def make_pretrain_step_tp(
     augment_impl: str = "xla",
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Contrastive train step with the projection head tensor-parallel over
-    the ``model`` mesh axis (global NT-Xent negatives over ``data``).
+    the ``model`` mesh axis (NT-Xent negatives per ``negatives``/``fused``,
+    defaulting to global rows over ``data``).
 
     Same contract as :func:`simclr_tpu.parallel.steps.make_pretrain_step`:
     ``(state, images_u8, rng) -> (state, metrics)``; ``state`` must be laid
@@ -241,6 +284,7 @@ def make_pretrain_step_tp(
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
+        negatives=negatives, fused=fused,
         remat=remat, grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
         augment_impl=augment_impl,
@@ -256,6 +300,8 @@ def make_pretrain_epoch_fn_tp(
     temperature: float = 0.5,
     strength: float = 0.5,
     out_size: int = 32,
+    negatives: str = "global",
+    fused: bool = False,
     remat: bool = False,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
@@ -289,6 +335,7 @@ def make_pretrain_epoch_fn_tp(
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
+        negatives=negatives, fused=fused,
         remat=remat, grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
         augment_impl=augment_impl,
@@ -336,6 +383,8 @@ def make_pretrain_superepoch_fn_tp(
     temperature: float = 0.5,
     strength: float = 0.5,
     out_size: int = 32,
+    negatives: str = "global",
+    fused: bool = False,
     remat: bool = False,
     residency: str = "replicated",
     grad_allreduce: str = "exact",
@@ -366,6 +415,7 @@ def make_pretrain_superepoch_fn_tp(
     step = _make_step_body(
         model, tx, mesh,
         temperature=temperature, strength=strength, out_size=out_size,
+        negatives=negatives, fused=fused,
         remat=remat, grad_allreduce=grad_allreduce,
         comm_overlap=comm_overlap, comm_chunks=comm_chunks,
         augment_impl=augment_impl,

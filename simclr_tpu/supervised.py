@@ -45,6 +45,7 @@ from simclr_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
+    enable_async_collective_flags,
     mesh_from_config,
     mesh_host_count,
     process_local_rows,
@@ -107,6 +108,14 @@ def run_supervised(cfg: Config) -> dict:
         )
     seed = int(cfg.parameter.seed)
 
+    comm_overlap = str(
+        normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
+    )
+    comm_chunks = int(cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS))
+    if comm_overlap == "async":
+        # must land in XLA_FLAGS before mesh_from_config initializes the
+        # backend; no-op off-TPU (parallel/mesh.py)
+        enable_async_collective_flags()
     mesh = mesh_from_config(cfg)
     if mesh.shape.get(MODEL_AXIS, 1) > 1 and is_logging_host():
         logger.warning(
@@ -186,6 +195,8 @@ def run_supervised(cfg: Config) -> dict:
         grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
         grad_elements=param_count(state.params),
         allreduce_devices=mesh.shape[DATA_AXIS],
+        comm_overlap=comm_overlap,
+        comm_chunks=comm_chunks,
     )
     events = EventLog(
         save_dir,
@@ -232,12 +243,8 @@ def run_supervised(cfg: Config) -> dict:
             model, tx, mesh, strength=float(cfg.experiment.strength),
             residency=residency,
             grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
-            comm_overlap=str(
-                normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
-            ),
-            comm_chunks=int(
-                cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
-            ),
+            comm_overlap=comm_overlap,
+            comm_chunks=comm_chunks,
             augment_impl=str(cfg.select("runtime.augment_impl", "xla")),
             sentry=sentry,
         )
@@ -249,12 +256,8 @@ def run_supervised(cfg: Config) -> dict:
         train_step = make_supervised_step(
             model, tx, mesh, strength=float(cfg.experiment.strength),
             grad_allreduce=str(cfg.select("parallel.grad_allreduce", "exact")),
-            comm_overlap=str(
-                normalize_overlap(cfg.select("parallel.comm_overlap", "off"))
-            ),
-            comm_chunks=int(
-                cfg.select("parallel.comm_chunks", DEFAULT_COMM_CHUNKS)
-            ),
+            comm_overlap=comm_overlap,
+            comm_chunks=comm_chunks,
             augment_impl=str(cfg.select("runtime.augment_impl", "xla")),
             sentry=sentry,
         )

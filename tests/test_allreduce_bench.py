@@ -91,6 +91,46 @@ def test_overlap_flag_adds_per_chunk_columns():
             assert abs(row["wire_mb_per_device"] - want_mb) < 1e-3, (mode, c)
 
 
+def test_async_flag_adds_eager_ring_rows_with_exposed_comm():
+    """--overlap-async (here via env, as the watcher's overlap_async stage
+    passes the flag) adds an "overlap_async" sibling table per mode — kept
+    apart from "overlap" so the chunked table's pinned shape never changes
+    — with ms/step, the ring's analytic wire bytes, and a MEASURED
+    exposed-comm column, plus the gradient-parity verdict and recompile
+    counter the watcher's done-marker greps for."""
+    r = _run({
+        "ALLREDUCE_BENCH_SIZES": "tiny=8192",
+        "ALLREDUCE_BENCH_ITERS": "1",
+        "ALLREDUCE_BENCH_MODES": "exact",
+        "ALLREDUCE_BENCH_ASYNC": "1",
+        "ALLREDUCE_BENCH_CHUNKS": "2",
+    }, timeout=480)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = _payload_lines(r.stdout)
+    assert len(lines) == 1, r.stdout
+    payload = json.loads(lines[0])
+    assert payload["overlap_chunks"] == [2]
+    assert payload["recompile_alarms"] == 0, payload
+    from simclr_tpu.parallel.compress import allreduce_wire_bytes
+
+    entry = payload["models"]["tiny"]["modes"]["exact"]
+    # async rows live in their own table; the chunked one was not requested
+    assert "overlap" not in entry
+    assert set(entry["overlap_async"]) == {"2"}
+    assert entry["exposed_comm_ms"] >= 0.0  # single-shot baseline column
+    row = entry["overlap_async"]["2"]
+    assert row["ms_per_step"] > 0.0
+    assert row["exposed_comm_ms"] >= 0.0
+    want_mb = allreduce_wire_bytes(
+        8192, 8, "exact", overlap="async", chunks=2
+    ) / 2**20
+    assert abs(row["wire_mb_per_device"] - want_mb) < 1e-3
+    # the same-dequantized-gradient invariant, measured: async handed the
+    # optimizer the single-shot ring's gradient
+    assert entry["async_matches_off"] is True, entry
+    assert entry["async_vs_off_max_rel_diff"] <= 1e-4
+
+
 def test_exhausted_budget_skips_loudly_and_still_emits():
     r = _run({
         "ALLREDUCE_BENCH_SIZES": "tiny=4096",

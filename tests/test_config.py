@@ -99,6 +99,36 @@ def test_validation_rejects_unknown_grad_allreduce():
         check_supervised_conf(sup)
 
 
+def test_validation_accepts_async_overlap_and_rejects_bad_chunks():
+    """parallel.comm_overlap=async is a shipped mode in both entry points;
+    the eager-ring path reuses comm_chunks, so a chunk count outside
+    [1, 64] (or a non-int) must be rejected up front — an invalid bucket
+    split would otherwise surface as a shape error mid-compile."""
+    from simclr_tpu.config import check_supervised_conf
+
+    cfg = load_config("config")
+    cfg.parallel.comm_overlap = "async"
+    check_pretrain_conf(cfg)  # async with the default comm_chunks passes
+    cfg.parallel.comm_chunks = 64
+    check_pretrain_conf(cfg)
+    for bad in (0, -1, 65, True):
+        cfg.parallel.comm_chunks = bad
+        with pytest.raises(ConfigError, match=r"comm_chunks.*\[1, 64\]"):
+            check_pretrain_conf(cfg)
+    cfg.parallel.comm_chunks = 4
+    cfg.parallel.comm_overlap = "eager"
+    with pytest.raises(ConfigError, match="off.*chunked.*async"):
+        check_pretrain_conf(cfg)
+
+    sup = load_config("supervised_config")
+    sup.parallel.comm_overlap = "async"
+    sup.parallel.comm_chunks = 8
+    check_supervised_conf(sup)
+    sup.parallel.comm_chunks = 0
+    with pytest.raises(ConfigError, match="comm_chunks"):
+        check_supervised_conf(sup)
+
+
 def test_serve_config_defaults_and_validation():
     cfg = load_config("serve")
     assert cfg.serve.max_batch == 256

@@ -286,9 +286,9 @@ class TestTelemetry:
         snap = t.snapshot()
         assert set(snap) == {
             "epoch", "step", "loss", "lr", "imgs_per_sec",
-            "imgs_per_sec_per_chip", "mfu", "slow_steps", "stalls",
-            "auto_traces", "compiles", "recompile_alarms", "uptime_s",
-            "mesh_hosts",
+            "imgs_per_sec_per_chip", "mfu", "exposed_comm_ms", "slow_steps",
+            "stalls", "auto_traces", "compiles", "recompile_alarms",
+            "uptime_s", "mesh_hosts",
         }
         assert snap["mesh_hosts"] == 1.0
         assert snap["loss"] == 2.5

@@ -70,6 +70,40 @@ class TestMesh:
         mesh = create_mesh(devices=jax.devices()[:1])
         assert mesh.shape[DATA_AXIS] == 1
 
+    def test_async_collective_flags_tpu_gated_and_idempotent(self):
+        """enable_async_collective_flags mutates XLA_FLAGS only on a TPU
+        platform (unknown --xla_tpu_* flags are fatal on CPU jaxlib) and
+        never duplicates a flag on repeat calls — main.py invokes it every
+        run when comm_overlap=async. Platform detection is env-based: the
+        function must run BEFORE backend init, so it can never consult
+        jax.default_backend()."""
+        from simclr_tpu.parallel.mesh import (
+            ASYNC_COLLECTIVE_XLA_FLAGS,
+            enable_async_collective_flags,
+        )
+
+        # off-TPU: a no-op, env untouched
+        env = {"JAX_PLATFORMS": "cpu"}
+        assert enable_async_collective_flags(env) is False
+        assert "XLA_FLAGS" not in env
+
+        # TPU: all flags appended, preserving whatever was already set
+        env = {"JAX_PLATFORMS": "tpu", "XLA_FLAGS": "--xla_dump_to=/tmp/d"}
+        assert enable_async_collective_flags(env) is True
+        for flag in ASYNC_COLLECTIVE_XLA_FLAGS:
+            assert env["XLA_FLAGS"].count(flag) == 1, flag
+        assert env["XLA_FLAGS"].startswith("--xla_dump_to=/tmp/d")
+
+        # idempotent: a second call adds nothing
+        before = env["XLA_FLAGS"]
+        assert enable_async_collective_flags(env) is True
+        assert env["XLA_FLAGS"] == before
+
+        # a pod worker without JAX_PLATFORMS still counts as TPU
+        env = {"TPU_NAME": "v4-8"}
+        assert enable_async_collective_flags(env) is True
+        assert "XLA_FLAGS" in env
+
 
 # ---------------------------------------------------------------------------
 # Pretrain step

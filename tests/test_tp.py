@@ -78,7 +78,8 @@ def test_sharded_head_forward_matches_unsharded():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
-def _run_steps(mesh, n_steps=2, per_device_batch=4, dtype=jnp.float32):
+def _run_steps(mesh, n_steps=2, per_device_batch=4, dtype=jnp.float32,
+               **step_kwargs):
     model = ContrastiveModel(
         base_cnn="resnet18", d=128, dtype=dtype,
         bn_cross_replica_axis=DATA_AXIS,
@@ -92,7 +93,9 @@ def _run_steps(mesh, n_steps=2, per_device_batch=4, dtype=jnp.float32):
         model, tx, jax.random.key(7), jnp.zeros((2, 32, 32, 3), jnp.float32)
     )
     state = jax.device_put(state, tp_state_shardings(mesh, state))
-    step = make_pretrain_step_tp(model, tx, mesh, temperature=0.5, strength=0.5)
+    step = make_pretrain_step_tp(
+        model, tx, mesh, temperature=0.5, strength=0.5, **step_kwargs
+    )
 
     n_data = mesh.shape[DATA_AXIS]
     global_batch = per_device_batch * n_data
@@ -472,6 +475,9 @@ def test_tp_epoch_compile_sharded_residency_matches_replicated():
 
 
 def test_tp_rejects_unsupported_combinations():
+    """loss.negatives / loss.fused are now first-class under mesh.model>1
+    (they dispatch inside the tp step body like the dp path); the one
+    remaining gap in the support matrix is the concat forward."""
     from simclr_tpu.main import run_pretrain
     from simclr_tpu.config import load_config
 
@@ -482,13 +488,63 @@ def test_tp_rejects_unsupported_combinations():
             "experiment.synthetic_size=64",
             "experiment.batches=4",
             "mesh.model=2",
-            "loss.negatives=ring",
+            "model.forward_mode=concat",
             "parameter.epochs=1",
             "parameter.warmup_epochs=0",
         ],
     )
     with pytest.raises(ValueError, match="tensor parallelism"):
         run_pretrain(cfg)
+
+
+@pytest.mark.slow
+def test_tp_builders_validate_loss_variants_eagerly():
+    """The tp builders accept every dp loss variant and reject the same
+    invalid combinations as parallel/steps.py, at construction time (before
+    any trace) so a bad config fails fast, not mid-compile."""
+    mesh = create_mesh(MeshSpec(data=4, model=2))
+    model = ContrastiveModel(
+        base_cnn="resnet18", d=128, dtype=jnp.float32,
+        bn_cross_replica_axis=DATA_AXIS,
+    )
+    tx = lars(0.1)
+    for negatives, fused in [
+        ("global", False), ("local", False), ("ring", False),
+        ("global", True), ("local", True),
+    ]:
+        make_pretrain_step_tp(
+            model, tx, mesh, negatives=negatives, fused=fused
+        )
+        make_pretrain_epoch_fn_tp(
+            model, tx, mesh, negatives=negatives, fused=fused
+        )
+    with pytest.raises(ValueError, match="global|local|ring"):
+        make_pretrain_step_tp(model, tx, mesh, negatives="cross")
+    with pytest.raises(ValueError, match="fused"):
+        make_pretrain_step_tp(model, tx, mesh, negatives="ring", fused=True)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("negatives,fused", [
+    ("local", False), ("ring", False), ("global", True),
+])
+def test_tp_loss_variants_match_degenerate_model_axis(negatives, fused):
+    """dp-vs-tp loss parity per NT-Xent variant: a (data=2, model=4) mesh
+    against its (data=2, model=1) degenerate with the SAME data-axis size,
+    so augmentation RNG streams are identical and the only difference is
+    the head sharding. Before the variants were threaded through tp.py the
+    builders silently ran negatives='global', unfused — this matrix pins
+    that each variant's ring/local/fused math survives the model axis.
+    (global+unfused is pinned by test_tp_step_matches_degenerate.)"""
+    devices = jax.devices()
+    mesh_tp = create_mesh(MeshSpec(data=2, model=4), devices=devices)
+    mesh_dp = create_mesh(MeshSpec(data=2, model=1), devices=devices[:2])
+
+    kw = dict(negatives=negatives, fused=fused)
+    losses_tp, _ = _run_steps(mesh_tp, **kw)
+    losses_dp, _ = _run_steps(mesh_dp, **kw)
+    assert all(np.isfinite(losses_tp))
+    np.testing.assert_allclose(losses_tp, losses_dp, rtol=1e-4)
 
 
 def test_tp_state_sharding_shapes():

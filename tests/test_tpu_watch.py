@@ -20,7 +20,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
-    "allreduce_bench", "augment_bench", "multihost_dryrun",
+    "allreduce_bench", "overlap_async", "augment_bench", "multihost_dryrun",
     "elastic_dryrun", "remat2048", "explore1024", "explore512",
     "supervisor_smoke", "obs_smoke", "compile_audit", "superepoch",
     "serve_scale", "run_report",
@@ -75,6 +75,21 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         '"value": 3.98, "unit": "x", "overlap_chunks": [2, 4, 8], '
         '"models": {"resnet18": {"modes": {"int8": {"ms_per_step": 1.5, '
         '"overlap": {"4": {"ms_per_step": 1.2}}}}}}}\';; esac',
+        # the overlap_async stage passes --overlap-async and greps for an
+        # error-free payload with the async table, gradient parity vs the
+        # single-shot ring, and a quiet recompile sentry; the plain
+        # *allreduce_bench.py* case above also substring-matches this
+        # invocation, harmlessly echoing the chunked payload alongside
+        'case "$*" in *allreduce_bench.py\\ --overlap-async*) '
+        'echo \'{"metric": "allreduce_wire_reduction_int8_vs_exact", '
+        '"value": 3.98, "unit": "x", "overlap_chunks": [2, 4, 8], '
+        '"models": {"resnet18": {"modes": {"int8": {"ms_per_step": 1.5, '
+        '"exposed_comm_ms": 0.41, '
+        '"overlap_async": {"4": {"ms_per_step": 1.1, '
+        '"exposed_comm_ms": 0.12}}, '
+        '"async_vs_off_max_rel_diff": 0.003, '
+        '"async_matches_off": true}}}}, '
+        '"recompile_alarms": 0}\';; esac',
         # the augment_bench stage greps its stdout for an error-free payload
         # carrying BOTH per-impl columns and a zero recompile-alarm count
         # (its script exits 0 even on error); the *bench.py* case below also
@@ -254,6 +269,38 @@ def test_allreduce_marker_requires_overlap_table(tmp_path):
     assert "stage allreduce_bench FAILED" in log.read_text()
     # and the stage really asked for the overlap columns
     assert "allreduce_bench.py --overlap" in calls.read_text()
+
+
+def test_overlap_async_marker_requires_parity_and_quiet_sentry(tmp_path):
+    """The overlap_async done-marker demands the full async claim: the
+    eager-ring table AND gradient parity with the single-shot path AND a
+    quiet recompile sentry. A payload whose async gradient diverged from
+    off ("async_matches_off": false) is a correctness failure, not a perf
+    number, and must not earn overlap_async.done."""
+    calls = _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"async_matches_off": true', '"async_matches_off": false'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "overlap_async" not in _done(state)
+    assert (state / "overlap_async.fails").exists()
+    assert "stage overlap_async FAILED" in log.read_text()
+    # the chunked stage sharing the script must be untouched
+    assert "allreduce_bench" in _done(state)
+    # and the stage really asked for the async rows
+    assert "allreduce_bench.py --overlap-async" in calls.read_text()
+
+    # second contract: parity proven but a recompile alarm fired mid-bench
+    # (an async schedule whose signature churns would alarm CompileSentry)
+    stub.write_text(stub.read_text()
+                    .replace('"async_matches_off": false',
+                             '"async_matches_off": true')
+                    .replace('"recompile_alarms": 0}',
+                             '"recompile_alarms": 2}'))
+    (state / "overlap_async.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "overlap_async" not in _done(state)
+    assert (state / "overlap_async.fails").exists()
 
 
 def test_augment_marker_requires_both_impl_columns(tmp_path):

@@ -23,6 +23,18 @@ and ``"parity": true``. Robustness contract (same as allreduce_bench.py):
 never exits nonzero, never ends on a traceback, emits EXACTLY ONE payload
 line; failures land in an ``"error"`` field.
 
+``--fleet`` runs the fleet-observability smoke (the ``fleet_smoke``
+watcher stage): a short fault-free 2-process elastic run with
+``telemetry.fleet=true``, whose supervisor-side FleetCollector must expose
+a merged scrape carrying gauges labeled for BOTH hosts plus the
+straggler-skew gauge, and embed the fleet snapshot into
+``supervisor_summary.json``. The evidence lines from the merged scrape are
+printed verbatim (the stage's done-marker greps them), then ONE payload::
+
+    {"metric": "fleet_smoke", "value": 1.0, "unit": "bool",
+     "hosts_seen": ["0", "1"], "skew_ratio": 1.08,
+     "summary_embeds_fleet": true, ...}
+
 ``--elastic`` runs the OTHER multi-host proof instead — the elastic
 supervisor's full kill/remesh/grow-back cycle (the ``elastic_dryrun``
 watcher stage): a 2-process CPU pretrain whose process 1 is hard-killed
@@ -35,7 +47,13 @@ differs across topologies, so bitwise is not expected). Its payload::
 
     {"metric": "elastic_dryrun", "value": 1.0, "unit": "bool",
      "outcome": "clean", "remesh_count": 2, "grow_back_count": 1,
-     "hosts": [2, 1, 2], "parity": true, ...}
+     "hosts": [2, 1, 2], "parity": true,
+     "fleet": {"hosts_seen": ["0", "1"], "skew_gauge_seen": true, ...}, ...}
+
+The elastic run also runs the fleet plane (``telemetry.fleet=true``): its
+merged scrape must label both hosts and expose the skew gauge, and the
+summary must embed the fleet snapshot — all part of the elastic payload's
+ok gate.
 
 Env knobs: ``MULTIHOST_DRYRUN_TIMEOUT_S`` (per-phase subprocess timeout,
 default 300), ``MULTIHOST_DRYRUN_COORD_TIMEOUT_S`` (rendezvous fail-fast
@@ -53,6 +71,8 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
+import urllib.request
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
@@ -194,6 +214,139 @@ ELASTIC_RECIPE = [
 ELASTIC_DIE_FAULT = "1:2"
 
 
+def _fleet_overrides(run_dir: str) -> list[str]:
+    """Fleet-plane knobs for an elastic run: every process publishes its
+    per-host exporter ready file and the supervisor's FleetCollector
+    scrapes them into the merged ``simclr_fleet_*`` endpoint (discovered
+    through ``<run_dir>/fleet.ready``)."""
+    return [
+        f"telemetry.ready_file={os.path.join(run_dir, 'telemetry.ready')}",
+        "telemetry.fleet=true",
+        # scrape fast enough that even the shrunken generation's short
+        # epochs land on the fleet page
+        "telemetry.fleet_poll_s=0.5",
+    ]
+
+
+SKEW_GAUGE = "simclr_fleet_step_time_skew_ratio"
+
+
+class _FleetWatch:
+    """Polls the supervisor's merged fleet endpoint while the run lives.
+
+    Collects the acceptance evidence: at least one gauge labeled for EACH
+    host, the straggler-skew gauge (and its last positive value), and a
+    few verbatim sample lines for the watcher log / done-marker greps.
+    """
+
+    def __init__(self, run_dir: str):
+        self.ready_path = os.path.join(run_dir, "fleet.ready")
+        self.hosts_seen: set[str] = set()
+        self.skew_gauge_seen = False
+        self.skew_ratio = 0.0
+        self.sample_lines: dict[str, str] = {}
+        self.scrapes = 0
+
+    def poll(self) -> None:
+        try:
+            with open(self.ready_path) as f:
+                info = json.load(f)
+            url = (
+                f"http://{info.get('host', '127.0.0.1')}:{info['port']}/metrics"
+            )
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                text = resp.read().decode()
+        except Exception:  # noqa: BLE001 - collector not up yet / mid-restart
+            return
+        self.scrapes += 1
+        for line in text.splitlines():
+            for rank in ("0", "1"):
+                if f'host="{rank}"' in line:
+                    self.hosts_seen.add(rank)
+                    self.sample_lines.setdefault(f"host{rank}", line)
+            if line.startswith(SKEW_GAUGE + " "):
+                self.skew_gauge_seen = True
+                self.sample_lines["skew"] = line
+                try:
+                    value = float(line.split()[1])
+                except (IndexError, ValueError):
+                    value = 0.0
+                if value > 0:
+                    self.skew_ratio = value
+
+    @property
+    def both_hosts_labeled(self) -> bool:
+        return {"0", "1"} <= self.hosts_seen
+
+    def evidence(self) -> dict:
+        return {
+            "hosts_seen": sorted(self.hosts_seen),
+            "skew_gauge_seen": self.skew_gauge_seen,
+            "skew_ratio": self.skew_ratio,
+            "fleet_scrapes": self.scrapes,
+        }
+
+    def print_samples(self) -> None:
+        # the evidence lines verbatim: tpu_watch's fleet_smoke done-marker
+        # greps this output for the host="1" label and the skew gauge
+        for key in ("host0", "host1", "skew"):
+            if key in self.sample_lines:
+                print(self.sample_lines[key], flush=True)
+
+
+def _run_elastic_supervisor(
+    cmd: list[str], env: dict, timeout_s: float, run_dir: str, label: str
+) -> tuple[dict, _FleetWatch]:
+    """Spawn the elastic supervisor, scraping the fleet endpoint while it
+    runs; returns (summary line, fleet evidence). Output goes to files,
+    not pipes — the poll loop below never drains, and a chatty supervisor
+    would deadlock a full pipe buffer."""
+    with tempfile.TemporaryFile(mode="w+") as out_f, \
+            tempfile.TemporaryFile(mode="w+") as err_f:
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=out_f, stderr=err_f, text=True,
+            cwd=REPO_ROOT,
+        )
+        watch = _FleetWatch(run_dir)
+        deadline = time.monotonic() + timeout_s
+        while proc.poll() is None and time.monotonic() < deadline:
+            watch.poll()
+            time.sleep(0.5)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+            raise RuntimeError(f"{label} timed out after {timeout_s:.0f}s")
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout, stderr = out_f.read(), err_f.read()
+    for line in stderr.splitlines()[-20:]:
+        print(f"# [{label}] {line}", file=sys.stderr)
+    summary = None
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                summary = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    if summary is None:
+        raise RuntimeError(
+            f"{label} exited {proc.returncode} with no summary line"
+        )
+    summary["_returncode"] = proc.returncode
+    return summary, watch
+
+
+def _summary_embeds_fleet(run_dir: str) -> bool:
+    try:
+        with open(os.path.join(run_dir, "supervisor_summary.json")) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return isinstance(payload, dict) and isinstance(payload.get("fleet"), dict)
+
+
 def _load_results(save_dir: str, label: str) -> dict:
     path = os.path.join(save_dir, "pretrain_results.json")
     try:
@@ -231,10 +384,11 @@ def elastic_main() -> None:
     elastic_dir = os.path.join(workdir, "elastic")
     ref_dir = os.path.join(workdir, "reference")
 
-    # phase 1: elastic run — process 1 hard-killed at its epoch-2 beat
+    # phase 1: elastic run — process 1 hard-killed at its epoch-2 beat;
+    # fleet plane on, its merged endpoint scraped live from this process
     elastic_env = dict(base_env)
     elastic_env["SIMCLR_FAULT_DIE_PROCESS"] = ELASTIC_DIE_FAULT
-    proc = subprocess.run(
+    summary, watch = _run_elastic_supervisor(
         [
             sys.executable, "-m", "simclr_tpu.supervisor.elastic",
             "--nprocs", str(NPROCS),
@@ -242,26 +396,12 @@ def elastic_main() -> None:
             "--force-cpu",
             "--coord-timeout-s", base_env["JAX_COORDINATOR_TIMEOUT_S"],
             "--", "pretrain", *ELASTIC_RECIPE,
+            *_fleet_overrides(elastic_dir),
             f"experiment.save_dir={elastic_dir}",
         ],
-        env=elastic_env, capture_output=True, text=True, timeout=timeout_s,
-        cwd=REPO_ROOT,
+        elastic_env, timeout_s, elastic_dir, "elastic",
     )
-    for line in proc.stderr.splitlines()[-20:]:
-        print(f"# [elastic] {line}", file=sys.stderr)
-    summary = None
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                summary = json.loads(line)
-                break
-            except json.JSONDecodeError:
-                continue
-    if summary is None:
-        raise RuntimeError(
-            f"elastic supervisor exited {proc.returncode} with no summary line"
-        )
+    returncode = summary.pop("_returncode")
 
     # phase 2: uninterrupted same-seed reference on the same 4-device
     # global mesh, single process
@@ -306,13 +446,26 @@ def elastic_main() -> None:
     outcome = summary.get("outcome")
     remesh_count = int(summary.get("remesh_count", 0) or 0)
     grow_back_count = int(summary.get("grow_back_count", 0) or 0)
+    # fleet acceptance: merged scrape carried gauges for BOTH hosts plus
+    # the skew gauge, and the run-end summary embeds the fleet snapshot.
+    # The embedded snapshot itself is kept OUT of the payload (its per-host
+    # "error" keys would trip the watcher's no-error grep).
+    embeds_fleet = (
+        isinstance(summary.pop("fleet", None), dict)
+        and _summary_embeds_fleet(elastic_dir)
+    )
+    fleet_ok = (
+        watch.both_hosts_labeled and watch.skew_gauge_seen and embeds_fleet
+    )
+    watch.print_samples()
     ok = (
         outcome == "clean"
-        and proc.returncode == 0
+        and returncode == 0
         and remesh_count >= 1
         and grow_back_count >= 1
         and parity
         and events_ok
+        and fleet_ok
     )
     payload = {
         "metric": "elastic_dryrun",
@@ -327,6 +480,7 @@ def elastic_main() -> None:
         "events": {
             k: events.get(k, 0) for k in ("host_lost", "remesh", "grow_back")
         },
+        "fleet": {**watch.evidence(), "summary_embeds_fleet": embeds_fleet},
         "supervisor": summary,
     }
     if not ok:
@@ -341,6 +495,69 @@ def elastic_main() -> None:
             failures.append(f"loss trajectory diverged (max delta {max_delta})")
         if not events_ok:
             failures.append(f"missing elastic events ({events})")
+        if not fleet_ok:
+            failures.append(f"fleet evidence incomplete ({watch.evidence()})")
+        payload["error"] = "; ".join(failures) or "unknown failure"
+    _emit_payload(payload)
+
+
+def fleet_main() -> None:
+    """Fleet-observability smoke: a fault-free 2-process elastic run whose
+    merged fleet scrape must label BOTH hosts and carry the straggler-skew
+    gauge, with the snapshot embedded in the run-end summary."""
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_backstop)
+    except ValueError:
+        pass
+    timeout_s = float(os.environ.get("FLEET_SMOKE_TIMEOUT_S", 900))
+    base_env = _scrubbed_env()
+    run_dir = os.path.join(tempfile.mkdtemp(prefix="fleet_smoke_"), "run")
+
+    summary, watch = _run_elastic_supervisor(
+        [
+            sys.executable, "-m", "simclr_tpu.supervisor.elastic",
+            "--nprocs", str(NPROCS),
+            "--devices-per-proc", str(ELASTIC_DEVICES_PER_PROC),
+            "--force-cpu",
+            "--coord-timeout-s", base_env["JAX_COORDINATOR_TIMEOUT_S"],
+            "--", "pretrain", *ELASTIC_RECIPE,
+            *_fleet_overrides(run_dir),
+            f"experiment.save_dir={run_dir}",
+        ],
+        base_env, timeout_s, run_dir, "fleet_smoke",
+    )
+    returncode = summary.pop("_returncode")
+    embeds_fleet = (
+        isinstance(summary.pop("fleet", None), dict)
+        and _summary_embeds_fleet(run_dir)
+    )
+    watch.print_samples()
+    outcome = summary.get("outcome")
+    ok = (
+        outcome == "clean"
+        and returncode == 0
+        and watch.both_hosts_labeled
+        and watch.skew_gauge_seen
+        and embeds_fleet
+    )
+    payload = {
+        "metric": "fleet_smoke",
+        "value": 1.0 if ok else 0.0,
+        "unit": "bool",
+        "outcome": outcome,
+        **watch.evidence(),
+        "summary_embeds_fleet": embeds_fleet,
+    }
+    if not ok:
+        failures = []
+        if outcome != "clean":
+            failures.append(f"outcome={outcome}")
+        if not watch.both_hosts_labeled:
+            failures.append(f"hosts seen {sorted(watch.hosts_seen)} != [0, 1]")
+        if not watch.skew_gauge_seen:
+            failures.append("no skew gauge on the merged scrape")
+        if not embeds_fleet:
+            failures.append("summary does not embed the fleet snapshot")
         payload["error"] = "; ".join(failures) or "unknown failure"
     _emit_payload(payload)
 
@@ -399,10 +616,18 @@ def main() -> None:
 
 if __name__ == "__main__":
     elastic_mode = "--elastic" in sys.argv[1:]
+    fleet_mode = "--fleet" in sys.argv[1:]
     if elastic_mode:
         _METRIC = "elastic_dryrun"
+    elif fleet_mode:
+        _METRIC = "fleet_smoke"
     try:
-        elastic_main() if elastic_mode else main()
+        if elastic_mode:
+            elastic_main()
+        elif fleet_mode:
+            fleet_main()
+        else:
+            main()
     except Exception as exc:  # last-ditch contract keeper: one line, rc 0
         print(f"# unexpected error: {exc!r}", file=sys.stderr)
         _emit_payload(last_ditch_payload(exc))

@@ -136,6 +136,36 @@ def main(argv: list[str] | None = None) -> int:
         # 3. healthz carries the same snapshot that rides heartbeat.json
         print("obs_smoke: /healthz", _get(base + "/healthz"), flush=True)
 
+        # 3b. fleet merge: a FleetCollector pointed at the same ready file
+        # (exactly what the supervisor runs with telemetry.fleet=true) must
+        # re-serve the live exporter's samples under the fleet namespace
+        # with a host label. fleet.py is stdlib-only, so this process still
+        # never imports jax.
+        from simclr_tpu.obs.fleet import FleetCollector
+
+        collector = FleetCollector(
+            save_dir, nprocs=1, train_ready_file=ready, poll_s=60.0,
+        )
+        try:
+            collector.scrape_once()
+            fleet_text = _get(
+                f"http://127.0.0.1:{collector.port}/metrics"
+            )
+            fleet_line = next(
+                (
+                    line
+                    for line in fleet_text.splitlines()
+                    if line.startswith("simclr_fleet_imgs_per_sec{")
+                ),
+                None,
+            )
+            if fleet_line is None:
+                print("obs_smoke: fleet merge missing the throughput gauge")
+                return 1
+            print(f"obs_smoke: fleet {fleet_line}", flush=True)
+        finally:
+            collector.close()
+
         # 4. one on-demand profiler capture (best-effort: trace support
         # varies by backend, so a failure here warns instead of failing).
         # stop_trace waits out the in-flight step, so the HTTP timeout must

@@ -54,7 +54,7 @@ MAX_STAGE_FAILS=3
 # chip lock — proves the pod code path on the host), then the remaining
 # step matrices, and last the supervisor kill/resume smoke (fault
 # tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench overlap_async augment_bench multihost_dryrun elastic_dryrun remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench overlap_async augment_bench multihost_dryrun elastic_dryrun fleet_smoke remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -290,6 +290,26 @@ run_stage() {
                 grep -q '"outcome": "clean"' "$out" \
                     && grep -Eq '"remesh_count": [1-9]' "$out" \
                     && grep -q '"parity": true' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        fleet_smoke)
+            # fleet observability e2e (scripts/multihost_dryrun.py --fleet):
+            # a fault-free 2-process CPU elastic run with telemetry.fleet=true
+            # whose supervisor-side FleetCollector must expose ONE merged
+            # scrape labeling BOTH hosts plus the straggler-skew gauge.
+            # CPU-only like multihost_dryrun — no chip lock. The script
+            # exits 0 even on error, so the done marker requires the
+            # host="1"-labeled gauge line AND the skew gauge on the printed
+            # scrape evidence and no error field in the payload.
+            out="$STATE/fleet_smoke.out"
+            timeout "$(stage_timeout 1200)" python scripts/multihost_dryrun.py \
+                --fleet > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q 'host="1"' "$out" \
+                    && grep -q 'simclr_fleet_step_time_skew_ratio' "$out" \
                     && ! grep -q '"error"' "$out"
                 rc=$?
             fi ;;

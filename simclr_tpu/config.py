@@ -567,6 +567,32 @@ def _check_telemetry_conf(cfg: Config) -> None:
         isinstance(hbm, bool),
         f"telemetry.hbm must be a boolean (true|false), got {hbm!r}",
     )
+    fleet = cfg.select("telemetry.fleet", False)
+    _require(
+        isinstance(fleet, bool),
+        f"telemetry.fleet must be a boolean (true|false), got {fleet!r}",
+    )
+    fleet_port = cfg.select("telemetry.fleet_port", 0)
+    _require(
+        isinstance(fleet_port, int) and not isinstance(fleet_port, bool)
+        and 0 <= fleet_port <= 65535,
+        "telemetry.fleet_port must be an int in [0, 65535] (0 = ephemeral, "
+        f"published via the fleet ready file), got {fleet_port!r}",
+    )
+    fleet_poll = cfg.select("telemetry.fleet_poll_s", 2.0)
+    _require(
+        isinstance(fleet_poll, (int, float)) and not isinstance(fleet_poll, bool)
+        and 0 < fleet_poll <= 3600,
+        "telemetry.fleet_poll_s must be in (0, 3600] seconds between fleet "
+        f"scrape passes, got {fleet_poll!r}",
+    )
+    fleet_stale = cfg.select("telemetry.fleet_stale_after_s", 30.0)
+    _require(
+        isinstance(fleet_stale, (int, float)) and not isinstance(fleet_stale, bool)
+        and 0 < fleet_stale <= 86400,
+        "telemetry.fleet_stale_after_s must be in (0, 86400] seconds before "
+        f"a silent host is gauged stale, got {fleet_stale!r}",
+    )
 
 
 def check_supervisor_conf(cfg: Config) -> None:

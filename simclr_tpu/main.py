@@ -228,10 +228,11 @@ def run_pretrain(cfg: Config) -> dict:
     # compile sentry (obs/compile.py): every lower/compile of the step
     # functions is timed, fingerprinted, and cost-analyzed; a post-warmup
     # recompile raises the alarm and reuses the detector's rate-limited
-    # auto-trace
-    sentry = (
-        maybe_sentry(cfg, telemetry=telemetry, events=events, detector=detector)
-        if is_logging_host() else None
+    # auto-trace. Runs on EVERY host — per-host compile/recompile counters
+    # feed the fleet view (events stay logging-host-only via EventLog's
+    # enabled gate)
+    sentry = maybe_sentry(
+        cfg, telemetry=telemetry, events=events, detector=detector
     )
     events.emit(
         "run_start", entry="pretrain", epochs=epochs,
@@ -586,9 +587,10 @@ def run_pretrain(cfg: Config) -> dict:
     # sampled at scrape time from the exporter thread — host-side allocator
     # queries, zero device syncs — reconciled against the preflight's
     # analytic footprint when epoch_compile computed one
-    monitor = (
-        maybe_monitor(cfg, events=events, expected_resident_bytes=resident_bytes)
-        if is_logging_host() else None
+    # every host monitors its OWN local devices' HBM — per-host watermarks
+    # are fleet gauges (the events stream stays logging-host-only)
+    monitor = maybe_monitor(
+        cfg, events=events, expected_resident_bytes=resident_bytes
     )
     if monitor is not None:
         telemetry.attach_device_monitor(monitor)
@@ -719,11 +721,12 @@ def run_pretrain(cfg: Config) -> dict:
             imgs_per_tick *= epochs_per_compile
     timer = StepTimer(imgs_per_tick, warmup=1 if epoch_compile else 3)
     stem = str(cfg.experiment.output_model_name)
-    # process-0 /metrics + /debug/trace exporter; None unless telemetry.port
-    # (or telemetry.ready_file for an ephemeral port) is configured
-    exporter = (
-        maybe_start_exporter(cfg, telemetry, save_dir)
-        if is_logging_host() else None
+    # per-host /metrics + /debug/trace exporter; None unless telemetry.port
+    # (or telemetry.ready_file for an ephemeral port) is configured. Every
+    # process runs one — process i>0 publishes telemetry.p<i>.ready — so
+    # the supervisor's FleetCollector sees the whole fleet
+    exporter = maybe_start_exporter(
+        cfg, telemetry, save_dir, process_index=jax.process_index()
     )
     guard.install_signals()
     try:
@@ -797,18 +800,20 @@ def run_pretrain(cfg: Config) -> dict:
                 chunk_losses[-1] = guard.checked_loss(cur_step, chunk_losses[-1])
                 epoch_loss = chunk_losses[-1]
                 dt = time.perf_counter() - epoch_t0
-                if is_logging_host():
-                    for j, e in enumerate(chunk):
-                        step_e = epoch_start_step + (j + 1) * steps_per_epoch
-                        telemetry.observe_epoch(
-                            e,
-                            epochs=epochs,
-                            step=step_e,
-                            steps=steps_per_epoch,
-                            seconds=dt / K,
-                            loss=chunk_losses[j],
-                            lr=float(schedule(max(step_e - 1, 0))),
-                        )
+                # per-host telemetry on EVERY host (the fleet skew gauge
+                # divides per-host step times): all inputs are host floats
+                # already in hand, so this adds no device syncs anywhere
+                for j, e in enumerate(chunk):
+                    step_e = epoch_start_step + (j + 1) * steps_per_epoch
+                    telemetry.observe_epoch(
+                        e,
+                        epochs=epochs,
+                        step=step_e,
+                        steps=steps_per_epoch,
+                        seconds=dt / K,
+                        loss=chunk_losses[j],
+                        lr=float(schedule(max(step_e - 1, 0))),
+                    )
                 guard.beat(cur_step, boundary, loss=epoch_loss)
                 if any(not math.isfinite(l) for l in chunk_losses):
                     # same rollback as the single-epoch path; under
@@ -980,19 +985,19 @@ def run_pretrain(cfg: Config) -> dict:
                 raise PreemptedRun(path)
 
             epoch_loss = guard.checked_loss(cur_step, float(metrics["loss"]))
-            if is_logging_host():
-                # epoch telemetry BEFORE the boundary beat, so the beat's
-                # snapshot (and any scrape) reflects the epoch that just
-                # finished; every input is a host float already in hand
-                telemetry.observe_epoch(
-                    epoch,
-                    epochs=epochs,
-                    step=cur_step,
-                    steps=cur_step - epoch_start_step,
-                    seconds=time.perf_counter() - epoch_t0,
-                    loss=epoch_loss,
-                    lr=float(schedule(max(cur_step - 1, 0))),
-                )
+            # epoch telemetry BEFORE the boundary beat, so the beat's
+            # snapshot (and any scrape) reflects the epoch that just
+            # finished; every input is a host float already in hand, and
+            # every host updates its OWN gauges for the fleet view
+            telemetry.observe_epoch(
+                epoch,
+                epochs=epochs,
+                step=cur_step,
+                steps=cur_step - epoch_start_step,
+                seconds=time.perf_counter() - epoch_t0,
+                loss=epoch_loss,
+                lr=float(schedule(max(cur_step - 1, 0))),
+            )
             guard.beat(cur_step, epoch, loss=epoch_loss)
             if not math.isfinite(epoch_loss):
                 # roll back to the newest verified checkpoint; a different

@@ -53,24 +53,37 @@ def events_path(save_dir: str) -> str:
     return os.path.join(save_dir, EVENTS_NAME)
 
 
-def read_events(path: str) -> list[dict]:
-    """Parse the timeline; skips unparseable lines (a SIGKILL can tear at
-    most the final line — ``O_APPEND`` writes keep whole lines atomic on
-    local filesystems, but the reader stays defensive) and returns ``[]``
-    when the file is absent."""
+def read_events_counted(path: str) -> tuple[list[dict], int]:
+    """Parse the timeline, counting unparseable lines instead of hiding
+    them. A SIGKILL can tear at most the final line — ``O_APPEND`` writes
+    keep whole lines atomic on local filesystems, but the reader stays
+    defensive — and the count lets ``obs/report.py`` flag a truncated
+    timeline instead of silently under-reporting. Returns
+    ``(events, skipped_lines)``; ``([], 0)`` when the file is absent."""
     events: list[dict] = []
+    skipped = 0
     try:
         with open(path) as f:
             for line in f:
+                if not line.strip():
+                    continue
                 try:
                     payload = json.loads(line)
                 except ValueError:
+                    skipped += 1
                     continue
                 if isinstance(payload, dict):
                     events.append(payload)
+                else:
+                    skipped += 1
     except OSError:
-        return []
-    return events
+        return [], 0
+    return events, skipped
+
+
+def read_events(path: str) -> list[dict]:
+    """:func:`read_events_counted` for callers that only want the events."""
+    return read_events_counted(path)[0]
 
 
 class EventLog:

@@ -1,4 +1,4 @@
-"""Process-0 telemetry exporter: a daemon HTTP server beside the host loop.
+"""Per-host telemetry exporter: a daemon HTTP server beside the host loop.
 
 Endpoints (docs/OBSERVABILITY.md):
 
@@ -17,6 +17,14 @@ publishes ``{"host", "port", "pid"}`` to the ready file; port 0 with no
 ready file means disabled (the default — a training run opens no sockets
 unless asked). Handler threads are daemons so a wedged scraper can never
 block the run's exit.
+
+EVERY training process runs one of these, not just process 0: process
+``i>0`` publishes to the derived ready file ``telemetry.p<i>.ready``
+(:func:`simclr_tpu.obs.fleet.telemetry_ready_path`), which is how the
+supervisor's ``FleetCollector`` discovers the whole fleet from one
+configured path. Like the per-host heartbeat, the exporter renders only
+host-side floats its own loop already fetched, so the zero-sync contract
+holds on every host.
 """
 
 from __future__ import annotations
@@ -187,13 +195,48 @@ def start_exporter(
     return exporter
 
 
-def maybe_start_exporter(cfg, telemetry, save_dir: str) -> TelemetryExporter | None:
+def maybe_start_exporter(
+    cfg, telemetry, save_dir: str, *, process_index: int = 0
+) -> TelemetryExporter | None:
     """The config-gated entry used by the trainers: ``telemetry.port=0``
-    without a ready file (the default) means no exporter at all."""
+    without a ready file (the default) means no exporter at all.
+
+    Called on EVERY host with its ``jax.process_index()``: process ``i>0``
+    publishes to the derived per-process ready file and, when a fixed port
+    is configured, falls back to an ephemeral one — on single-machine
+    multi-process dryruns every host would otherwise race for the same
+    port. A bind failure on a non-zero process is logged and swallowed
+    rather than killing a training host over a metrics socket.
+    """
     port = int(cfg.select("telemetry.port", 0) or 0)
     ready_file = cfg.select("telemetry.ready_file")
     if port == 0 and not ready_file:
         return None
+    if process_index:
+        from simclr_tpu.obs.fleet import telemetry_ready_path
+
+        if ready_file:
+            ready_file = telemetry_ready_path(str(ready_file), process_index)
+            port = 0
+        else:
+            # fixed port, no discovery file: plausible on real pods (one
+            # process per machine), collision-prone on one machine
+            try:
+                return start_exporter(
+                    telemetry,
+                    save_dir,
+                    host=str(cfg.select("telemetry.host", "127.0.0.1")),
+                    port=port,
+                    trace_max_ms=float(
+                        cfg.select("telemetry.trace_max_ms", 60000)
+                    ),
+                )
+            except OSError as e:
+                logger.warning(
+                    "telemetry exporter disabled on process %d: %s",
+                    process_index, e,
+                )
+                return None
     return start_exporter(
         telemetry,
         save_dir,

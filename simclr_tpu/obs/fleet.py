@@ -1,0 +1,506 @@
+"""Fleet observability plane: one merged view over every host and replica.
+
+The per-process exporters (``obs/exporter.py``) answer "what is THIS host
+doing"; this module answers "what is the FLEET doing". A
+:class:`FleetCollector` runs as a daemon thread inside the supervisor
+(both ``supervisor/runner.py`` and ``supervisor/elastic.py``), scrapes
+every training host's ``/metrics`` + ``/healthz`` — discovered through the
+per-process ready files ``telemetry.ready`` / ``telemetry.p<i>.ready``
+(:func:`telemetry_ready_path`) — plus any serve-replica ``/metrics``
+endpoints, and re-serves them merged on one HTTP endpoint:
+
+  * ``GET /metrics``       — every host sample re-labeled
+    ``simclr_train_X`` → ``simclr_fleet_X{host="N"}`` and every serve
+    sample ``simclr_serve_X`` → ``simclr_fleet_serve_X{replica="N"}``,
+    plus the derived fleet gauges below;
+  * ``GET /fleet/healthz`` — the JSON fleet snapshot (also embedded into
+    ``supervisor_summary.json`` at run end). ``/healthz`` is an alias.
+
+Derived straggler gauges make a slow host visible BEFORE the wedge
+watchdog fires:
+
+  * ``simclr_fleet_step_time_skew_ratio`` — slowest/fastest per-host step
+    time across hosts currently reporting (1 = perfectly even; SPMD makes
+    every host wait for the slowest, so skew is pure waste);
+  * ``simclr_fleet_slowest_host`` — the host index behind that ratio;
+  * ``simclr_fleet_heartbeat_age_seconds{host="N"}`` — per-host liveness
+    staleness from the ``heartbeat.p<i>.json`` files;
+  * ``simclr_fleet_ready_file_missing/stale{host="N"}`` — a host whose
+    ready file is gone (not started, or exited cleanly) or points at a
+    dead port (killed without cleanup) is gauged, never raised on.
+
+Scraping is read-only HTTP against exporters that render host-side floats
+only, so the collector can never add a device sync to any training host —
+the zero-sync contract holds fleet-wide by construction.
+
+Stdlib-only by contract (plus ``supervisor.heartbeat``, itself stdlib):
+the supervisor must never import jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlsplit
+
+from simclr_tpu.supervisor.heartbeat import heartbeat_path, read_heartbeat
+
+FLEET_READY_NAME = "fleet.ready"
+
+_TRAIN_PREFIX = "simclr_train_"
+_SERVE_PREFIX = "simclr_serve_"
+_FLEET_PREFIX = "simclr_fleet_"
+
+
+def telemetry_ready_path(ready_file: str, process_index: int = 0) -> str:
+    """Per-process exporter ready file, mirroring ``heartbeat_path``.
+
+    Process 0 keeps the configured path exactly (everything pre-fleet reads
+    it); process ``i>0`` gets ``.p<i>`` spliced in before the final suffix —
+    ``telemetry.ready`` → ``telemetry.p1.ready`` — so one configured path
+    names the whole fleet's discovery files.
+    """
+    if not process_index:
+        return ready_file
+    head, tail = os.path.split(ready_file)
+    stem, dot, suffix = tail.rpartition(".")
+    if dot:
+        tail = f"{stem}.p{int(process_index)}.{suffix}"
+    else:
+        tail = f"{tail}.p{int(process_index)}"
+    return os.path.join(head, tail)
+
+
+def _relabel_line(line: str, extra_label: str) -> tuple[str, str, str] | None:
+    """Split one exposition sample line into (name, labels, value) with
+    ``extra_label`` merged in front; None for comments/blank/garbage."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    try:
+        metric, value = line.rsplit(None, 1)
+    except ValueError:
+        return None
+    if "{" in metric:
+        name, _, rest = metric.partition("{")
+        labels = rest.rstrip("}")
+        merged = f"{extra_label},{labels}" if labels else extra_label
+    else:
+        name, merged = metric, extra_label
+    return name, merged, value
+
+
+def _fleet_name(name: str, kind: str) -> str:
+    """``simclr_train_X`` → ``simclr_fleet_X``; ``simclr_serve_X`` →
+    ``simclr_fleet_serve_X``; anything else keeps its tail under the
+    fleet prefix so the merged page has exactly one namespace."""
+    if kind == "replica":
+        if name.startswith(_SERVE_PREFIX):
+            return _FLEET_PREFIX + "serve_" + name[len(_SERVE_PREFIX):]
+        return _FLEET_PREFIX + "serve_" + name.removeprefix("simclr_")
+    if name.startswith(_TRAIN_PREFIX):
+        return _FLEET_PREFIX + name[len(_TRAIN_PREFIX):]
+    return _FLEET_PREFIX + name.removeprefix("simclr_")
+
+
+class _EndpointState:
+    """Last-known scrape state for one host or replica endpoint."""
+
+    def __init__(self):
+        self.ready_missing = True
+        self.ready_stale = False  # ready file present but scrape failed
+        self.error: str | None = None
+        self.metrics_text: str | None = None
+        self.snapshot: dict | None = None
+        self.scraped_at: float | None = None  # monotonic of last GOOD scrape
+
+    @property
+    def up(self) -> bool:
+        return not self.ready_missing and not self.ready_stale
+
+
+class FleetHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, address, collector: "FleetCollector"):
+        super().__init__(address, FleetHandler)
+        self.collector = collector
+
+
+class FleetHandler(BaseHTTPRequestHandler):
+    server: FleetHTTPServer
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = urlsplit(self.path).path
+        if path == "/metrics":
+            self._send(
+                200,
+                self.server.collector.render().encode(),
+                "text/plain; version=0.0.4",
+            )
+        elif path in ("/fleet/healthz", "/healthz"):
+            self._send(
+                200,
+                json.dumps(self.server.collector.snapshot()).encode(),
+                "application/json",
+            )
+        else:
+            self._send(
+                404,
+                json.dumps({"error": f"unknown path {path!r}"}).encode(),
+                "application/json",
+            )
+
+
+class FleetCollector:
+    """Scrape every host/replica endpoint; merge, derive, re-serve.
+
+    Tolerates absent children at every stage: a missing ready file, a ready
+    file pointing at a dead port (the SIGKILLed host never ran ``close()``),
+    a half-started exporter — each becomes a gauge on the fleet page, never
+    an exception in the supervisor.
+    """
+
+    def __init__(
+        self,
+        save_dir: str,
+        *,
+        nprocs: int = 1,
+        train_ready_file: str | None = None,
+        serve_ready_files: tuple[str, ...] = (),
+        poll_s: float = 2.0,
+        stale_after_s: float = 30.0,
+        timeout_s: float = 3.0,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ready_file: str | None = None,
+    ):
+        self.save_dir = save_dir
+        self.nprocs = int(nprocs)
+        self.train_ready_file = train_ready_file
+        self.serve_ready_files = tuple(serve_ready_files)
+        self.poll_s = float(poll_s)
+        self.stale_after_s = float(stale_after_s)
+        self.timeout_s = float(timeout_s)
+        self.ready_file = str(ready_file) if ready_file else None
+
+        self._hosts: dict[int, _EndpointState] = {
+            i: _EndpointState() for i in range(self.nprocs)
+        }
+        self._replicas: dict[int, _EndpointState] = {
+            i: _EndpointState() for i in range(len(self.serve_ready_files))
+        }
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._scrapes = 0
+        self._scrape_errors = 0
+
+        self._server = FleetHTTPServer((host, int(port)), self)
+        self.host, self.port = self._server.server_address[:2]
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.2},
+            name="fleet-collector-http",
+            daemon=True,
+        )
+        self._serve_thread.start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="fleet-collector-poll", daemon=True
+        )
+        self._poll_thread.start()
+        if self.ready_file:
+            from simclr_tpu.utils.ioutil import atomic_write
+
+            # the supervisor starts the collector before any child has
+            # created the run directory
+            os.makedirs(os.path.dirname(self.ready_file) or ".", exist_ok=True)
+            atomic_write(
+                self.ready_file,
+                lambda f: json.dump(
+                    {"host": self.host, "port": self.port, "pid": os.getpid()},
+                    f,
+                ),
+            )
+
+    # -- scraping -----------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            self.scrape_once()
+            self._stop.wait(self.poll_s)
+
+    def _read_ready(self, path: str) -> dict | None:
+        try:
+            with open(path) as f:
+                info = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return info if isinstance(info, dict) and "port" in info else None
+
+    def _fetch(self, addr: dict, path: str) -> str | None:
+        url = f"http://{addr.get('host', '127.0.0.1')}:{addr['port']}{path}"
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            return resp.read().decode()
+
+    def _scrape_endpoint(self, state: _EndpointState, ready_path: str | None,
+                         *, want_snapshot: bool) -> None:
+        if not ready_path:
+            state.ready_missing = True
+            return
+        addr = self._read_ready(ready_path)
+        if addr is None:
+            # not started yet, or a clean exit removed it — gauge, don't raise
+            state.ready_missing = True
+            state.ready_stale = False
+            state.error = None
+            return
+        state.ready_missing = False
+        try:
+            metrics = self._fetch(addr, "/metrics")
+            snapshot = None
+            if want_snapshot:
+                body = self._fetch(addr, "/healthz")
+                payload = json.loads(body) if body else None
+                snapshot = payload if isinstance(payload, dict) else None
+        except (urllib.error.URLError, OSError, ValueError,
+                ConnectionError, TimeoutError) as e:
+            # ready file present but nobody answering: a killed host left a
+            # stale address behind
+            state.ready_stale = True
+            state.error = str(e)
+            with self._lock:
+                self._scrape_errors += 1
+            return
+        state.ready_stale = False
+        state.error = None
+        state.metrics_text = metrics
+        if want_snapshot:
+            state.snapshot = snapshot
+        state.scraped_at = time.monotonic()
+
+    def scrape_once(self) -> None:
+        """One pass over every endpoint (also what the poll thread runs)."""
+        for rank, state in self._hosts.items():
+            ready = (
+                telemetry_ready_path(self.train_ready_file, rank)
+                if self.train_ready_file
+                else None
+            )
+            self._scrape_endpoint(state, ready, want_snapshot=True)
+        for idx, state in self._replicas.items():
+            self._scrape_endpoint(
+                state, self.serve_ready_files[idx], want_snapshot=False
+            )
+        with self._lock:
+            self._scrapes += 1
+
+    # -- derived views ------------------------------------------------------
+
+    def _step_times(self) -> dict[int, float]:
+        out = {}
+        for rank, state in self._hosts.items():
+            snap = state.snapshot or {}
+            try:
+                step_time = float(snap.get("step_time_s"))
+            except (TypeError, ValueError):
+                continue
+            if step_time > 0:
+                out[rank] = step_time
+        return out
+
+    def _heartbeat_ages(self, now: float) -> dict[int, float | None]:
+        ages: dict[int, float | None] = {}
+        for rank in self._hosts:
+            beat = read_heartbeat(heartbeat_path(self.save_dir, rank))
+            when = beat.get("time") if beat else None
+            ages[rank] = (
+                round(max(0.0, now - when), 3)
+                if isinstance(when, (int, float))
+                else None
+            )
+        return ages
+
+    def snapshot(self) -> dict:
+        """The ``/fleet/healthz`` JSON — also what the supervisor summary
+        embeds at run end."""
+        now = time.time()
+        mono = time.monotonic()
+        step_times = self._step_times()
+        ages = self._heartbeat_ages(now)
+        skew, slowest = 0.0, None
+        if step_times:
+            slowest = max(step_times, key=step_times.get)
+            skew = round(step_times[slowest] / min(step_times.values()), 4)
+        hosts = {}
+        for rank, state in self._hosts.items():
+            snap = state.snapshot or {}
+            hosts[str(rank)] = {
+                "up": state.up,
+                "ready_missing": state.ready_missing,
+                "ready_stale": state.ready_stale,
+                "error": state.error,
+                "heartbeat_age_s": ages[rank],
+                "scrape_age_s": (
+                    round(mono - state.scraped_at, 3)
+                    if state.scraped_at is not None
+                    else None
+                ),
+                "step_time_s": step_times.get(rank),
+                "step": snap.get("step"),
+                "epoch": snap.get("epoch"),
+                "imgs_per_sec": snap.get("imgs_per_sec"),
+            }
+        replicas = {
+            str(idx): {
+                "up": state.up,
+                "ready_missing": state.ready_missing,
+                "ready_stale": state.ready_stale,
+                "error": state.error,
+            }
+            for idx, state in self._replicas.items()
+        }
+        with self._lock:
+            scrapes, errors = self._scrapes, self._scrape_errors
+        return {
+            "status": "ok",
+            "hosts_expected": self.nprocs,
+            "hosts_up": sum(1 for s in self._hosts.values() if s.up),
+            "replicas_expected": len(self._replicas),
+            "replicas_up": sum(1 for s in self._replicas.values() if s.up),
+            "step_time_skew_ratio": skew,
+            "slowest_host": slowest,
+            "hosts": hosts,
+            "replicas": replicas,
+            "scrapes": scrapes,
+            "scrape_errors": errors,
+        }
+
+    def render(self) -> str:
+        """The merged ``/metrics`` page: derived fleet gauges first, then
+        every host/replica sample re-labeled into the fleet namespace."""
+        snap = self.snapshot()
+        lines = [
+            "# fleet: merged scrape of "
+            f"{snap['hosts_expected']} host(s), "
+            f"{snap['replicas_expected']} replica(s)",
+            f"# TYPE {_FLEET_PREFIX}hosts_expected gauge",
+            f"{_FLEET_PREFIX}hosts_expected {snap['hosts_expected']:g}",
+            f"# TYPE {_FLEET_PREFIX}hosts_up gauge",
+            f"{_FLEET_PREFIX}hosts_up {snap['hosts_up']:g}",
+            f"# TYPE {_FLEET_PREFIX}replicas_up gauge",
+            f"{_FLEET_PREFIX}replicas_up {snap['replicas_up']:g}",
+            f"# TYPE {_FLEET_PREFIX}step_time_skew_ratio gauge",
+            f"{_FLEET_PREFIX}step_time_skew_ratio "
+            f"{snap['step_time_skew_ratio']:g}",
+            f"# TYPE {_FLEET_PREFIX}scrapes_total counter",
+            f"{_FLEET_PREFIX}scrapes_total {snap['scrapes']:g}",
+            f"# TYPE {_FLEET_PREFIX}scrape_errors_total counter",
+            f"{_FLEET_PREFIX}scrape_errors_total {snap['scrape_errors']:g}",
+        ]
+        if snap["slowest_host"] is not None:
+            lines.append(f"# TYPE {_FLEET_PREFIX}slowest_host gauge")
+            lines.append(
+                f"{_FLEET_PREFIX}slowest_host {snap['slowest_host']:g}"
+            )
+        for rank_str, info in snap["hosts"].items():
+            label = f'host="{rank_str}"'
+            lines.append(
+                f"{_FLEET_PREFIX}host_up{{{label}}} {int(info['up']):g}"
+            )
+            lines.append(
+                f"{_FLEET_PREFIX}ready_file_missing{{{label}}} "
+                f"{int(info['ready_missing']):g}"
+            )
+            lines.append(
+                f"{_FLEET_PREFIX}ready_file_stale{{{label}}} "
+                f"{int(info['ready_stale']):g}"
+            )
+            if info["heartbeat_age_s"] is not None:
+                lines.append(
+                    f"{_FLEET_PREFIX}heartbeat_age_seconds{{{label}}} "
+                    f"{info['heartbeat_age_s']:g}"
+                )
+            if info["step_time_s"] is not None:
+                lines.append(
+                    f"{_FLEET_PREFIX}host_step_time_seconds{{{label}}} "
+                    f"{info['step_time_s']:g}"
+                )
+        for rank, state in self._hosts.items():
+            if not state.metrics_text:
+                continue
+            extra = f'host="{rank}"'
+            for line in state.metrics_text.splitlines():
+                parsed = _relabel_line(line, extra)
+                if parsed is None:
+                    continue
+                name, labels, value = parsed
+                lines.append(
+                    f"{_fleet_name(name, 'host')}{{{labels}}} {value}"
+                )
+        for idx, state in self._replicas.items():
+            if not state.metrics_text:
+                continue
+            extra = f'replica="{idx}"'
+            for line in state.metrics_text.splitlines():
+                parsed = _relabel_line(line, extra)
+                if parsed is None:
+                    continue
+                name, labels, value = parsed
+                lines.append(
+                    f"{_fleet_name(name, 'replica')}{{{labels}}} {value}"
+                )
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        self._stop.set()
+        self._poll_thread.join(timeout=5.0)
+        self._server.shutdown()
+        self._serve_thread.join(timeout=5.0)
+        self._server.server_close()
+        if self.ready_file:
+            try:
+                os.unlink(self.ready_file)
+            except OSError:
+                pass
+
+
+def maybe_start_fleet(cfg, save_dir: str, *, nprocs: int = 1) -> FleetCollector | None:
+    """Config gate for the supervisors: ``telemetry.fleet=true`` starts the
+    collector (its ready file defaults to ``<save_dir>/fleet.ready``)."""
+    if not cfg.select("telemetry.fleet", False):
+        return None
+    ready_file = cfg.select("telemetry.fleet_ready_file") or os.path.join(
+        save_dir, FLEET_READY_NAME
+    )
+    serve_ready = cfg.select("telemetry.fleet_serve_ready_files")
+    serve_ready_files = tuple(
+        p.strip() for p in str(serve_ready).split(",") if p.strip()
+    ) if serve_ready else ()
+    return FleetCollector(
+        save_dir,
+        nprocs=nprocs,
+        train_ready_file=cfg.select("telemetry.ready_file"),
+        serve_ready_files=serve_ready_files,
+        poll_s=float(cfg.select("telemetry.fleet_poll_s", 2.0)),
+        stale_after_s=float(cfg.select("telemetry.fleet_stale_after_s", 30.0)),
+        port=int(cfg.select("telemetry.fleet_port", 0) or 0),
+        ready_file=ready_file,
+    )

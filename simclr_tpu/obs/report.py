@@ -26,12 +26,19 @@ both stdlib-only) so it runs on any machine holding the run directory.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
+import re
 import sys
 
-from simclr_tpu.obs.events import events_path, read_events
+from simclr_tpu.obs.events import events_path, read_events_counted
 from simclr_tpu.supervisor.heartbeat import heartbeat_path, read_heartbeat
+
+# a fleet whose slowest host runs >25% behind its fastest is flagged: SPMD
+# collectives make every step as slow as the slowest participant, so this
+# much skew is pure fleet-wide waste
+SKEW_FLAG_RATIO = 1.25
 
 VERDICT_OK = "OK"
 VERDICT_REGRESSION = "REGRESSION"
@@ -93,13 +100,46 @@ def load_baseline(path: str) -> float | None:
     return value if value > 0 else None
 
 
+def _fleet_hosts(run_dir: str) -> dict[str, dict]:
+    """One row per per-host heartbeat file: the post-mortem's view of each
+    host's last known step/epoch/step-time (``heartbeat.json`` is host 0,
+    ``heartbeat.p<i>.json`` is host ``i``)."""
+    hosts: dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, "heartbeat*.json"))):
+        base = os.path.basename(path)
+        if base == "heartbeat.json":
+            index = 0
+        else:
+            match = re.fullmatch(r"heartbeat\.p(\d+)\.json", base)
+            if not match:
+                continue
+            index = int(match.group(1))
+        beat = read_heartbeat(path)
+        if beat is None:
+            continue
+        telemetry = beat.get("telemetry")
+        telemetry = telemetry if isinstance(telemetry, dict) else {}
+        hosts[str(index)] = {
+            "step": beat.get("step"),
+            "epoch": beat.get("epoch"),
+            "status": beat.get("status"),
+            "beat_time": beat.get("time"),
+            "step_time_s": telemetry.get("step_time_s"),
+            "imgs_per_sec": telemetry.get("imgs_per_sec"),
+        }
+    return hosts
+
+
 def build_report(
     run_dir: str,
     *,
     baseline_path: str | None = None,
     threshold: float = DEFAULT_THRESHOLD,
 ) -> dict:
-    events = read_events(events_path(run_dir))
+    # torn lines (a crash mid-O_APPEND truncates at most the tail line) are
+    # skipped but COUNTED: the report must say the timeline is incomplete
+    # instead of silently under-reporting or tracebacking on json.loads
+    events, torn_lines = read_events_counted(events_path(run_dir))
     attempts: dict[int, dict] = {}
     for event in events:
         try:
@@ -181,6 +221,30 @@ def build_report(
         telemetry = heartbeat["telemetry"]
     supervisor = _load_json(os.path.join(run_dir, SUMMARY_NAME))
 
+    # fleet view: one row per heartbeat.p<i>.json (every host beats), the
+    # skew/slowest verdict from the supervisor's embedded FleetCollector
+    # snapshot when present, recomputed from the beats otherwise
+    hosts = _fleet_hosts(run_dir)
+    fleet = (
+        supervisor.get("fleet")
+        if supervisor and isinstance(supervisor.get("fleet"), dict)
+        else None
+    )
+    skew, slowest = None, None
+    if fleet is not None:
+        skew = fleet.get("step_time_skew_ratio") or None
+        slowest = fleet.get("slowest_host")
+    if skew is None:
+        step_times = {
+            h: row["step_time_s"]
+            for h, row in hosts.items()
+            if isinstance(row.get("step_time_s"), (int, float))
+            and row["step_time_s"] > 0
+        }
+        if step_times:
+            slowest = max(step_times, key=step_times.get)
+            skew = round(step_times[slowest] / min(step_times.values()), 4)
+
     measured = None
     if telemetry is not None:
         try:
@@ -208,6 +272,11 @@ def build_report(
         "attempts": {str(a): attempts[a] for a in sorted(attempts)},
         "stalled_attempts": stalled,
         "hosts_timeline": hosts_timeline,
+        "torn_lines": torn_lines,
+        "hosts": hosts,
+        "fleet": fleet,
+        "step_time_skew_ratio": skew,
+        "slowest_host": slowest,
         "outcome": supervisor.get("outcome") if supervisor else None,
         "supervisor": supervisor,
         "heartbeat": heartbeat,
@@ -232,6 +301,11 @@ def render_report(report: dict) -> str:
     if report.get("hosts_timeline"):
         lines.append(
             "hosts: " + "→".join(str(n) for n in report["hosts_timeline"])
+        )
+    if report.get("torn_lines"):
+        lines.append(
+            f"WARNING: {report['torn_lines']} torn event line(s) skipped "
+            "(events.jsonl truncated mid-write)"
         )
     for attempt, entry in report["attempts"].items():
         duration = (
@@ -282,6 +356,47 @@ def render_report(report: dict) -> str:
             "stalled attempts: "
             + ", ".join(str(a) for a in report["stalled_attempts"])
         )
+    hosts = report.get("hosts") or {}
+    if len(hosts) > 1 or report.get("fleet") is not None:
+        skew = report.get("step_time_skew_ratio")
+        if skew is not None:
+            verdict = "STRAGGLER" if skew > SKEW_FLAG_RATIO else "even"
+            skew_part = (
+                f" skew={skew:.2f}x ({verdict},"
+                f" slowest=host {report.get('slowest_host')})"
+            )
+        else:
+            skew_part = ""
+        fleet = report.get("fleet") or {}
+        up_part = (
+            f" up={fleet['hosts_up']}/{fleet['hosts_expected']}"
+            if "hosts_up" in fleet else ""
+        )
+        lines.append(f"fleet: hosts={len(hosts)}{up_part}{skew_part}")
+        for host, row in sorted(hosts.items(), key=lambda kv: int(kv[0])):
+            step_time = (
+                f"{row['step_time_s']:.4f}s"
+                if isinstance(row.get("step_time_s"), (int, float))
+                else "?"
+            )
+            rate = (
+                f"{row['imgs_per_sec']:.1f}"
+                if isinstance(row.get("imgs_per_sec"), (int, float))
+                else "?"
+            )
+            lines.append(
+                f"  host {host}: step={row.get('step')} "
+                f"epoch={row.get('epoch')} step_time={step_time} "
+                f"imgs/s={rate}"
+            )
+        trace = os.path.join(report["run_dir"], "timeline_trace.json")
+        if os.path.exists(trace):
+            lines.append(f"timeline: {trace}")
+        else:
+            lines.append(
+                "timeline: python -m simclr_tpu.obs.timeline "
+                f"{report['run_dir']}"
+            )
     telemetry = report.get("telemetry") or {}
     if telemetry.get("exposed_comm_ms") is not None:
         # step time beyond roofline compute — the wire the scheduler did NOT

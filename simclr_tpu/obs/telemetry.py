@@ -229,6 +229,7 @@ class Telemetry:
             self.exposed_comm_ms,
         )
         self._started = time.time()
+        self._last_step_time = 0.0
 
     def attach_device_monitor(self, monitor) -> None:
         """Render the DeviceMonitor's HBM gauges with every scrape.
@@ -265,6 +266,7 @@ class Telemetry:
         seconds = max(float(seconds), 1e-9)
         step_time = seconds / steps
         self.step_time.observe(step_time)
+        self._last_step_time = step_time
         rate = steps * self.global_batch / seconds
         self.imgs_per_sec.set(rate)
         self.imgs_per_sec_per_chip.set(rate / self.n_devices)
@@ -336,6 +338,9 @@ class Telemetry:
             "step": self.step.value,
             "loss": self.loss.value,
             "lr": self.lr.value,
+            # the FleetCollector's skew ratio divides these across hosts,
+            # so the snapshot carries the scalar, not just the histogram
+            "step_time_s": self._last_step_time,
             "imgs_per_sec": self.imgs_per_sec.value,
             "imgs_per_sec_per_chip": self.imgs_per_sec_per_chip.value,
             "mfu": self.mfu.value,

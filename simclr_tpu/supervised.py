@@ -218,10 +218,10 @@ def run_supervised(cfg: Config) -> dict:
         if is_logging_host() else None
     )
     # compile sentry (obs/compile.py): times/fingerprints/cost-analyzes
-    # every step compilation, alarms on post-warmup recompiles
-    sentry = (
-        maybe_sentry(cfg, telemetry=telemetry, events=events, detector=detector)
-        if is_logging_host() else None
+    # every step compilation, alarms on post-warmup recompiles. Runs on
+    # EVERY host so per-host compile counters feed the fleet view
+    sentry = maybe_sentry(
+        cfg, telemetry=telemetry, events=events, detector=detector
     )
 
     epoch_compile = bool(cfg.select("runtime.epoch_compile", False))
@@ -267,9 +267,9 @@ def run_supervised(cfg: Config) -> dict:
         )
     # live HBM accounting (obs/device.py): sampled per scrape from the
     # exporter thread — host-side allocator queries, zero device syncs
-    monitor = (
-        maybe_monitor(cfg, events=events, expected_resident_bytes=resident_bytes)
-        if is_logging_host() else None
+    # every host monitors its own local devices' HBM for the fleet view
+    monitor = maybe_monitor(
+        cfg, events=events, expected_resident_bytes=resident_bytes
     )
     if monitor is not None:
         telemetry.attach_device_monitor(monitor)
@@ -430,10 +430,11 @@ def run_supervised(cfg: Config) -> dict:
     # run already completed) must still reach tracer.close/timer.summary
     train_metrics = {"loss": jnp.zeros(()), "accuracy": jnp.zeros(())}
     stem = f"supervised-{cfg.experiment.name}.pt"
-    # /metrics + /healthz + /debug/trace exporter (process 0 only; disabled
-    # by default — see telemetry.port in conf/supervised_config.yaml)
-    exporter = (
-        maybe_start_exporter(cfg, telemetry, save_dir) if is_logging_host() else None
+    # per-host /metrics + /healthz + /debug/trace exporter (disabled by
+    # default — see telemetry.port in conf/supervised_config.yaml); process
+    # i>0 publishes telemetry.p<i>.ready for the FleetCollector
+    exporter = maybe_start_exporter(
+        cfg, telemetry, save_dir, process_index=jax.process_index()
     )
     guard.install_signals()
     try:
@@ -503,15 +504,15 @@ def run_supervised(cfg: Config) -> dict:
                 cur_step, float(train_metrics["loss"])
             )
             # telemetry BEFORE the beat so the heartbeat snapshot is fresh;
-            # host floats only (see obs/telemetry.py) — zero extra syncs
-            if is_logging_host():
-                telemetry.observe_epoch(
-                    epoch, epochs=epochs, step=cur_step,
-                    steps=cur_step - epoch_start_step,
-                    seconds=time.perf_counter() - epoch_t0,
-                    loss=epoch_loss,
-                    lr=float(schedule(max(cur_step - 1, 0))),
-                )
+            # host floats only (see obs/telemetry.py) — zero extra syncs,
+            # and every host updates its OWN gauges for the fleet view
+            telemetry.observe_epoch(
+                epoch, epochs=epochs, step=cur_step,
+                steps=cur_step - epoch_start_step,
+                seconds=time.perf_counter() - epoch_t0,
+                loss=epoch_loss,
+                lr=float(schedule(max(cur_step - 1, 0))),
+            )
             guard.beat(cur_step, epoch, loss=epoch_loss)
             if not math.isfinite(epoch_loss):
                 # roll back to the newest verified checkpoint; a different

@@ -155,6 +155,7 @@ class ElasticSupervisor:
         coord_timeout_s: float | None = None,
         env: dict | None = None,
         events: EventLog | None = None,
+        fleet=None,
     ):
         if nprocs < 1:
             raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -174,6 +175,10 @@ class ElasticSupervisor:
         self.events = events if events is not None else EventLog(
             save_dir, enabled=False
         )
+        # optional obs.fleet.FleetCollector: scrapes every generation's
+        # per-host exporters for the run's lifetime; its final snapshot is
+        # embedded into the summary. The caller owns its lifecycle.
+        self.fleet = fleet
         self.hosts = [_Host(i) for i in range(self.nprocs)]
         self.remesh_count = 0
         self.grow_back_count = 0
@@ -297,6 +302,8 @@ class ElasticSupervisor:
             }
             if error:
                 result["error"] = error
+            if self.fleet is not None:
+                result["fleet"] = self.fleet.snapshot()
             self.events.emit(
                 "outcome", outcome=outcome, exit=exit_code,
                 attempt=generation, remesh_count=self.remesh_count,
@@ -613,6 +620,11 @@ def main(argv: list[str] | None = None) -> int:
     # generation gets a rescaled per-device override appended (trailing
     # overrides win)
     global_batch = per_device * args.devices_per_proc * args.nprocs
+    # fleet plane (telemetry.fleet=true): scrape every generation's per-host
+    # exporters and serve the merged simclr_fleet_* endpoint for the run
+    from simclr_tpu.obs.fleet import maybe_start_fleet
+
+    fleet = maybe_start_fleet(cfg, save_dir, nprocs=args.nprocs)
     supervisor = ElasticSupervisor(
         [sys.executable, "-m", module, *overrides],
         save_dir,
@@ -626,8 +638,13 @@ def main(argv: list[str] | None = None) -> int:
         events=EventLog(
             save_dir, enabled=bool(cfg.select("telemetry.events", True))
         ),
+        fleet=fleet,
     )
-    result = supervisor.run()
+    try:
+        result = supervisor.run()
+    finally:
+        if fleet is not None:
+            fleet.close()
     print(json.dumps(result), flush=True)
     return int(result["exit"])
 

@@ -185,6 +185,7 @@ def supervise(
     resume_args: tuple[str, ...] | list[str] = (),
     env: dict | None = None,
     events: EventLog | None = None,
+    fleet=None,
 ) -> dict:
     """Run ``cmd`` under supervision until a terminal outcome; returns the
     summary dict (also written to ``<save_dir>/supervisor_summary.json``).
@@ -198,6 +199,11 @@ def supervise(
     child exits, hangs, backed-off restarts, the terminal outcome — into
     the child's own ``events.jsonl``, each stamped with the attempt it
     describes.
+
+    ``fleet`` (a running :class:`~simclr_tpu.obs.fleet.FleetCollector`, or
+    None) scrapes the child's per-host exporters for the run's lifetime;
+    its final snapshot is embedded into the summary under ``"fleet"``. The
+    caller owns its lifecycle (``main()`` starts and closes it).
     """
     os.makedirs(save_dir, exist_ok=True)
     hb_path = heartbeat_path(save_dir)
@@ -258,6 +264,10 @@ def supervise(
             elif kind == "auto_trace":
                 counts["auto_traces"] += 1
         summary["anomalies"] = counts
+        if fleet is not None:
+            # the fleet plane's last word: per-host up/staleness, step-time
+            # skew, slowest host — the post-mortem's multi-host view
+            summary["fleet"] = fleet.snapshot()
         events.emit(
             "outcome", outcome=outcome, exit=exit_code, attempt=attempt,
             resumed=attempt - 1,
@@ -386,11 +396,21 @@ def main(argv: list[str] | None = None) -> int:
         overrides = overrides + [f"experiment.save_dir={save_dir}"]
 
     cmd = [sys.executable, "-m", module, *overrides]
-    summary = supervise(
-        cmd, save_dir, knobs, resume_args=("experiment.resume=true",),
-        events=EventLog(
-            save_dir, enabled=bool(cfg.select("telemetry.events", True))
-        ),
-    )
+    # fleet plane (telemetry.fleet=true): scrape the child's per-host
+    # exporters and serve the merged simclr_fleet_* endpoint for the run
+    from simclr_tpu.obs.fleet import maybe_start_fleet
+
+    fleet = maybe_start_fleet(cfg, save_dir)
+    try:
+        summary = supervise(
+            cmd, save_dir, knobs, resume_args=("experiment.resume=true",),
+            events=EventLog(
+                save_dir, enabled=bool(cfg.select("telemetry.events", True))
+            ),
+            fleet=fleet,
+        )
+    finally:
+        if fleet is not None:
+            fleet.close()
     print(json.dumps(summary), flush=True)
     return int(summary["exit"])

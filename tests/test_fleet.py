@@ -1,0 +1,440 @@
+"""Fleet observability plane (simclr_tpu/obs/fleet.py, obs/timeline.py).
+
+Covers the merged-scrape tentpole and its tolerance contracts:
+
+* per-process ready-file naming (``telemetry.ready`` → ``telemetry.p1.ready``)
+  and the per-host exporter entry (``maybe_start_exporter`` on process i>0);
+* :class:`FleetCollector` — re-labeling host/replica samples into the
+  ``simclr_fleet_*`` namespace, straggler-skew derivation, the
+  ``/fleet/healthz`` snapshot, and the own-ready-file lifecycle;
+* degraded fleets: a missing ready file (host not started / clean exit) and
+  a stale one (SIGKILLed host, dead port) become gauges, never exceptions;
+* the cross-host Perfetto timeline: a 2-attempt elastic
+  kill→remesh→grow-back fixture must yield a trace-event document with
+  valid ``ph``/``ts``/``pid`` keys, monotonic per-track timestamps, and one
+  track per host — loadable straight into ui.perfetto.dev.
+"""
+
+import json
+import os
+import socket
+import urllib.request
+
+import pytest
+
+from simclr_tpu.obs.events import EventLog
+from simclr_tpu.obs.exporter import maybe_start_exporter, start_exporter
+from simclr_tpu.obs.fleet import (
+    FleetCollector,
+    _fleet_name,
+    _relabel_line,
+    maybe_start_fleet,
+    telemetry_ready_path,
+)
+from simclr_tpu.obs.timeline import (
+    PID_HOST_BASE,
+    PID_SERVE,
+    PID_SUPERVISOR,
+    build_timeline,
+    trace_path,
+)
+from simclr_tpu.supervisor.heartbeat import heartbeat_path, write_heartbeat
+
+pytestmark = pytest.mark.obs
+
+
+class _HostTelemetry:
+    """render()/snapshot() duck type standing in for one training host."""
+
+    def __init__(self, step_time, imgs_per_sec=100.0):
+        self.step_time = step_time
+        self.imgs_per_sec = imgs_per_sec
+
+    def render(self):
+        return (
+            "# HELP simclr_train_imgs_per_sec Images per second\n"
+            "# TYPE simclr_train_imgs_per_sec gauge\n"
+            f"simclr_train_imgs_per_sec {self.imgs_per_sec:g}\n"
+            'simclr_train_grad_allreduce_mode{mode="exact"} 1\n'
+        )
+
+    def snapshot(self):
+        return {
+            "epoch": 2.0,
+            "step": 4.0,
+            "step_time_s": self.step_time,
+            "imgs_per_sec": self.imgs_per_sec,
+        }
+
+
+class _ReplicaTelemetry:
+    def render(self):
+        return "simclr_serve_requests_total 7\n"
+
+    def snapshot(self):
+        return {"status": "ok"}
+
+
+def _free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestReadyPathNaming:
+    def test_process_zero_keeps_configured_path(self):
+        assert telemetry_ready_path("/run/telemetry.ready", 0) == (
+            "/run/telemetry.ready"
+        )
+
+    def test_suffix_splice_mirrors_heartbeat_path(self):
+        assert telemetry_ready_path("/run/telemetry.ready", 1) == (
+            "/run/telemetry.p1.ready"
+        )
+        assert telemetry_ready_path("/run/telemetry.ready", 12) == (
+            "/run/telemetry.p12.ready"
+        )
+
+    def test_suffixless_path_appends(self):
+        assert telemetry_ready_path("/run/ready", 2) == "/run/ready.p2"
+
+
+class TestRelabel:
+    def test_bare_sample_gains_label(self):
+        assert _relabel_line("x 1", 'host="0"') == ("x", 'host="0"', "1")
+
+    def test_existing_labels_are_merged_after_host(self):
+        name, labels, value = _relabel_line('x{a="b"} 2.5', 'host="3"')
+        assert (name, labels, value) == ("x", 'host="3",a="b"', "2.5")
+
+    def test_comments_and_blanks_are_dropped(self):
+        assert _relabel_line("# HELP x y", 'host="0"') is None
+        assert _relabel_line("", 'host="0"') is None
+
+    def test_fleet_namespace_mapping(self):
+        assert _fleet_name("simclr_train_loss", "host") == "simclr_fleet_loss"
+        assert _fleet_name("simclr_serve_requests_total", "replica") == (
+            "simclr_fleet_serve_requests_total"
+        )
+
+
+class TestPerHostExporter:
+    def _cfg(self, overrides):
+        from simclr_tpu.config import load_config
+
+        return load_config("config", overrides=overrides)
+
+    def test_nonzero_process_derives_ready_and_close_removes(self, tmp_path):
+        # satellite contract: every process writes its OWN discovery file
+        # and removes it on clean exit — a survivor never squats on the
+        # configured (process-0) path
+        ready = tmp_path / "telemetry.ready"
+        cfg = self._cfg([f"telemetry.ready_file={ready}"])
+        exp = maybe_start_exporter(
+            cfg, _HostTelemetry(0.01), str(tmp_path), process_index=1
+        )
+        p1 = tmp_path / "telemetry.p1.ready"
+        try:
+            assert exp is not None
+            assert not ready.exists()
+            info = json.load(open(p1))
+            assert info["port"] == exp.port and exp.port > 0
+        finally:
+            exp.close()
+        assert not p1.exists()
+
+    def test_nonzero_process_fixed_port_collision_is_swallowed(self, tmp_path):
+        # two processes on one machine racing for telemetry.port: process 0
+        # owns it, process 1 must log-and-continue, never die over a socket
+        holder = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        holder.bind(("127.0.0.1", 0))
+        holder.listen(1)
+        port = holder.getsockname()[1]
+        cfg = self._cfg([f"telemetry.port={port}"])
+        try:
+            assert maybe_start_exporter(
+                cfg, _HostTelemetry(0.01), str(tmp_path), process_index=1
+            ) is None
+            with pytest.raises(OSError):
+                start_exporter(
+                    _HostTelemetry(0.01), str(tmp_path),
+                    port=port, trace_max_ms=5000,
+                )
+        finally:
+            holder.close()
+
+
+@pytest.fixture
+def two_host_fleet(tmp_path):
+    """Two live exporters (ranks 0/1, step times 0.010/0.013), their
+    heartbeats, and a collector that scrapes on demand (poll_s parked)."""
+    ready = tmp_path / "telemetry.ready"
+    exporters = [
+        start_exporter(
+            _HostTelemetry(0.010), str(tmp_path), trace_max_ms=5000,
+            ready_file=str(ready),
+        ),
+        start_exporter(
+            _HostTelemetry(0.013, imgs_per_sec=80.0), str(tmp_path),
+            trace_max_ms=5000,
+            ready_file=telemetry_ready_path(str(ready), 1),
+        ),
+    ]
+    for rank in (0, 1):
+        write_heartbeat(heartbeat_path(str(tmp_path), rank), step=4, epoch=2)
+    collector = FleetCollector(
+        str(tmp_path), nprocs=2, train_ready_file=str(ready),
+        poll_s=60.0, ready_file=str(tmp_path / "fleet.ready"),
+    )
+    yield tmp_path, exporters, collector
+    collector.close()
+    for exp in exporters:
+        exp.close()
+
+
+class TestFleetCollector:
+    def test_merged_render_labels_both_hosts(self, two_host_fleet):
+        _, _, collector = two_host_fleet
+        collector.scrape_once()
+        text = collector.render()
+        assert 'simclr_fleet_imgs_per_sec{host="0"} 100' in text
+        assert 'simclr_fleet_imgs_per_sec{host="1"} 80' in text
+        # pre-existing labels merge after the host label
+        assert (
+            'simclr_fleet_grad_allreduce_mode{host="1",mode="exact"} 1'
+            in text
+        )
+        assert 'simclr_fleet_host_up{host="0"} 1' in text
+        assert 'simclr_fleet_host_up{host="1"} 1' in text
+        assert 'simclr_fleet_heartbeat_age_seconds{host="0"}' in text
+        assert "simclr_fleet_hosts_expected 2" in text
+
+    def test_straggler_skew_and_slowest_host(self, two_host_fleet):
+        _, _, collector = two_host_fleet
+        collector.scrape_once()
+        snap = collector.snapshot()
+        assert snap["hosts_up"] == 2
+        assert snap["step_time_skew_ratio"] == pytest.approx(1.3)
+        assert snap["slowest_host"] == 1
+        assert snap["hosts"]["1"]["step_time_s"] == pytest.approx(0.013)
+        text = collector.render()
+        assert "simclr_fleet_step_time_skew_ratio 1.3" in text
+        assert "simclr_fleet_slowest_host 1" in text
+
+    def test_http_endpoint_serves_merged_page_and_fleet_healthz(
+        self, two_host_fleet
+    ):
+        tmp_path, _, collector = two_host_fleet
+        collector.scrape_once()
+        status, body = _get(
+            f"http://127.0.0.1:{collector.port}/metrics"
+        )
+        assert status == 200 and 'host="1"' in body
+        status, body = _get(
+            f"http://127.0.0.1:{collector.port}/fleet/healthz"
+        )
+        snap = json.loads(body)
+        assert status == 200 and snap["status"] == "ok"
+        assert snap["hosts_up"] == 2
+        # discovery: the collector publishes its own ready file
+        info = json.load(open(tmp_path / "fleet.ready"))
+        assert info["port"] == collector.port
+
+    def test_killed_host_becomes_stale_gauge_not_exception(
+        self, two_host_fleet
+    ):
+        tmp_path, exporters, collector = two_host_fleet
+        collector.scrape_once()
+        # SIGKILL never runs close(): fake it by pointing host 1's ready
+        # file at a port nobody answers
+        dead = {"host": "127.0.0.1", "port": _free_port(), "pid": 0}
+        p1 = tmp_path / "telemetry.p1.ready"
+        p1.write_text(json.dumps(dead))
+        collector.scrape_once()
+        snap = collector.snapshot()
+        assert snap["hosts_up"] == 1
+        assert snap["hosts"]["1"]["ready_stale"] is True
+        assert snap["hosts"]["1"]["error"]
+        assert snap["scrape_errors"] >= 1
+        text = collector.render()
+        assert 'simclr_fleet_ready_file_stale{host="1"} 1' in text
+        # last-known samples survive for forensics
+        assert 'simclr_fleet_imgs_per_sec{host="1"} 80' in text
+
+    def test_clean_exit_becomes_missing_gauge(self, two_host_fleet):
+        _, exporters, collector = two_host_fleet
+        collector.scrape_once()
+        exporters[1].close()  # clean exit unlinks telemetry.p1.ready
+        collector.scrape_once()
+        snap = collector.snapshot()
+        assert snap["hosts"]["1"]["ready_missing"] is True
+        assert snap["hosts"]["1"]["ready_stale"] is False
+        assert snap["hosts"]["1"]["error"] is None
+        assert 'simclr_fleet_ready_file_missing{host="1"} 1' in (
+            collector.render()
+        )
+
+    def test_close_removes_own_ready_file(self, tmp_path):
+        collector = FleetCollector(
+            str(tmp_path), poll_s=60.0,
+            ready_file=str(tmp_path / "fleet.ready"),
+        )
+        assert (tmp_path / "fleet.ready").exists()
+        collector.close()
+        assert not (tmp_path / "fleet.ready").exists()
+
+    def test_serve_replica_samples_are_relabeled(self, tmp_path):
+        serve_ready = tmp_path / "serve.ready"
+        replica = start_exporter(
+            _ReplicaTelemetry(), str(tmp_path), trace_max_ms=5000,
+            ready_file=str(serve_ready),
+        )
+        collector = FleetCollector(
+            str(tmp_path), nprocs=0, serve_ready_files=(str(serve_ready),),
+            poll_s=60.0,
+        )
+        try:
+            collector.scrape_once()
+            snap = collector.snapshot()
+            assert snap["replicas_up"] == 1
+            assert (
+                'simclr_fleet_serve_requests_total{replica="0"} 7'
+                in collector.render()
+            )
+        finally:
+            collector.close()
+            replica.close()
+
+    def test_maybe_start_fleet_config_gate(self, tmp_path):
+        from simclr_tpu.config import load_config
+
+        assert maybe_start_fleet(load_config("config"), str(tmp_path)) is None
+        cfg = load_config("config", overrides=["telemetry.fleet=true"])
+        collector = maybe_start_fleet(cfg, str(tmp_path), nprocs=2)
+        try:
+            assert collector is not None and collector.nprocs == 2
+            assert collector.ready_file == str(tmp_path / "fleet.ready")
+            assert (tmp_path / "fleet.ready").exists()
+        finally:
+            collector.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-host Perfetto timeline (obs/timeline.py)
+# ---------------------------------------------------------------------------
+
+
+def _elastic_run_dir(tmp_path):
+    """Golden fixture: 2-host elastic run — host 1 killed mid-epoch-2,
+    remesh 2→1, grow back, remesh 1→2, finish clean — three attempts."""
+    run = tmp_path / "elastic_run"
+    run.mkdir()
+    log = EventLog(str(run))
+    log.emit("run_start", epochs=3, attempt=1)
+    log.emit("epoch", epoch=1, loss=2.5, seconds=0.4, attempt=1)
+    log.emit("checkpoint", epoch=1, attempt=1)
+    log.emit("host_lost", host=1, reason="heartbeat timeout", attempt=1)
+    log.emit("remesh", hosts_before=2, hosts_after=1, attempt=1)
+    log.emit("restart", attempt=2)
+    log.emit("run_start", epochs=3, attempt=2)
+    log.emit("epoch", epoch=2, loss=2.1, seconds=0.5, attempt=2)
+    log.emit("grow_back", hosts=[1], attempt=2)
+    log.emit("remesh", hosts_before=1, hosts_after=2, attempt=2)
+    log.emit("run_start", epochs=3, attempt=3)
+    log.emit("epoch", epoch=3, loss=1.9, seconds=0.3, attempt=3)
+    log.emit("outcome", outcome="clean", attempt=3)
+    write_heartbeat(heartbeat_path(str(run), 0), step=3, epoch=3)
+    write_heartbeat(heartbeat_path(str(run), 1), step=3, epoch=3)
+    with open(run / "supervisor_summary.json", "w") as f:
+        json.dump({
+            "outcome": "clean", "remesh_count": 2, "grow_back_count": 1,
+            "hosts_timeline": [2, 1, 2],
+        }, f)
+    with open(run / "events.jsonl", "a") as f:
+        f.write('{"event": "epoch", "epo')  # torn tail: SIGKILL mid-write
+    return str(run)
+
+
+class TestTimeline:
+    def test_golden_elastic_trace_structure(self, tmp_path):
+        doc = build_timeline(_elastic_run_dir(tmp_path))
+        events = doc["traceEvents"]
+        assert events and doc["displayTimeUnit"] == "ms"
+
+        # every row carries the trace-event required keys
+        for e in events:
+            assert e["ph"] in ("M", "X", "i")
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+            if e["ph"] != "M":
+                assert isinstance(e["ts"], int) and e["ts"] >= 0
+            if e["ph"] == "i":
+                assert e["s"] == "t"
+            if e["ph"] == "X":
+                assert e["dur"] > 0
+
+        # one track per host plus supervisor; labeled for the viewer
+        pids = {e["pid"] for e in events if e["ph"] != "M"}
+        assert {PID_SUPERVISOR, PID_HOST_BASE, PID_HOST_BASE + 1} <= pids
+        names = {
+            e["pid"]: e["args"]["name"]
+            for e in events
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert names[PID_HOST_BASE] == "host 0"
+        assert names[PID_HOST_BASE + 1] == "host 1"
+        assert names[PID_SUPERVISOR] == "supervisor"
+        assert names[PID_SERVE] == "serve"
+
+        # monotonic per-track timestamps
+        tracks = {}
+        for e in events:
+            if e["ph"] != "M":
+                tracks.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+        for ts_list in tracks.values():
+            assert ts_list == sorted(ts_list)
+
+        # epochs render as spans with their measured duration
+        spans = {e["name"]: e for e in events if e["ph"] == "X"}
+        assert spans["epoch 1"]["dur"] == 400000
+        assert spans["epoch 2"]["tid"] == 2
+        # lifecycle lands on the supervisor track, host_lost on its host
+        by_name = {e["name"]: e for e in events if e["ph"] == "i"}
+        assert by_name["remesh 2→1"]["pid"] == PID_SUPERVISOR
+        assert by_name["host_lost (heartbeat timeout)"]["pid"] == (
+            PID_HOST_BASE + 1
+        )
+        assert by_name["outcome: clean"]["pid"] == PID_SUPERVISOR
+        assert by_name["last_heartbeat"]["pid"] in (
+            PID_HOST_BASE, PID_HOST_BASE + 1
+        )
+
+        # the torn tail is counted, and the summary rides along
+        assert doc["otherData"]["torn_lines"] == 1
+        assert doc["otherData"]["outcome"] == "clean"
+        assert doc["otherData"]["hosts_timeline"] == [2, 1, 2]
+
+    def test_cli_writes_loadable_json(self, tmp_path, capsys):
+        from simclr_tpu.obs import timeline as timeline_mod
+
+        run = _elastic_run_dir(tmp_path)
+        assert timeline_mod.main([run]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("timeline: ")
+        assert "1 torn line(s) skipped" in out
+        with open(trace_path(run)) as f:
+            doc = json.load(f)
+        assert doc["traceEvents"]
+
+    def test_empty_run_dir_yields_valid_document(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        doc = build_timeline(str(empty))
+        assert doc["otherData"]["torn_lines"] == 0
+        # metadata-only: host 0 is always declared so the doc never renders
+        # as a blank page
+        assert all(e["ph"] == "M" for e in doc["traceEvents"])

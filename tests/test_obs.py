@@ -285,13 +285,15 @@ class TestTelemetry:
         t.observe_epoch(1, epochs=2, step=2, steps=2, seconds=1.0, loss=2.5, lr=0.3)
         snap = t.snapshot()
         assert set(snap) == {
-            "epoch", "step", "loss", "lr", "imgs_per_sec",
+            "epoch", "step", "loss", "lr", "step_time_s", "imgs_per_sec",
             "imgs_per_sec_per_chip", "mfu", "exposed_comm_ms", "slow_steps",
             "stalls", "auto_traces", "compiles", "recompile_alarms",
             "uptime_s", "mesh_hosts",
         }
         assert snap["mesh_hosts"] == 1.0
         assert snap["loss"] == 2.5
+        # the fleet straggler ratio divides these across hosts
+        assert snap["step_time_s"] == pytest.approx(0.5)
         assert json.loads(json.dumps(snap)) == snap  # heartbeat-serializable
 
     def test_checkpoint_and_rollback_counters(self):
@@ -947,6 +949,10 @@ class TestConfigValidation:
             ("telemetry.auto_trace_cooldown_s=-1", "[0, 86400]"),
             ("telemetry.auto_trace_max=0", "[1, 100]"),
             ("telemetry.auto_trace_max=101", "[1, 100]"),
+            ("telemetry.fleet=maybe", "(true|false)"),
+            ("telemetry.fleet_port=65536", "[0, 65535]"),
+            ("telemetry.fleet_poll_s=0", "(0, 3600]"),
+            ("telemetry.fleet_stale_after_s=0", "(0, 86400]"),
         ],
     )
     def test_bad_knobs_name_the_valid_range(self, override, expected_range):
@@ -1100,6 +1106,75 @@ class TestEndToEnd:
         assert [e["epoch"] for e in events if e["event"] == "epoch"] == [1, 2]
         assert "checkpoint" in kinds
         assert {e["attempt"] for e in events} == {1}
+
+    def test_nonzero_process_scrape_adds_zero_syncs(self, tmp_path, monkeypatch):
+        """The fleet plane runs an exporter on EVERY host, so the zero-sync
+        contract must hold for a non-logging process too: a run seen as
+        process 1 (exporter publishing ``telemetry.p1.ready``, no event log,
+        no detector) scraped continuously performs EXACTLY the fences of the
+        same non-logging run with no exporter. ``jax.process_index`` itself
+        stays 0 (patching it would corrupt mesh/data sharding in this
+        single-process harness); only the observability call sites see the
+        non-zero identity."""
+        from simclr_tpu import main as main_mod
+        from simclr_tpu.obs import exporter as exporter_mod
+        from simclr_tpu.obs.fleet import telemetry_ready_path
+
+        real_maybe = exporter_mod.maybe_start_exporter
+
+        def as_process_1(cfg, telemetry, save_dir, *, process_index=0):
+            return real_maybe(cfg, telemetry, save_dir, process_index=1)
+
+        monkeypatch.setattr(main_mod, "maybe_start_exporter", as_process_1)
+        monkeypatch.setattr(main_mod, "is_logging_host", lambda: False)
+        base = SYNTH + ["parameter.epochs=2", "telemetry.anomaly_warmup=2"]
+
+        plain_dir = tmp_path / "plain"
+        plain_dir.mkdir()  # non-logging hosts never makedirs the run dir
+        _, baseline_syncs = _run_pretrain_counting_syncs(
+            base + [f"experiment.save_dir={plain_dir}"], monkeypatch
+        )
+
+        obs_dir = tmp_path / "observed"
+        obs_dir.mkdir()
+        ready = obs_dir / "telemetry.ready"
+        p1_ready = telemetry_ready_path(str(ready), 1)
+        assert p1_ready.endswith("telemetry.p1.ready")
+        scrapes = [0]
+
+        def scrape(worker):
+            deadline = time.monotonic() + 600
+            port = None
+            while time.monotonic() < deadline and worker.is_alive():
+                if port is None:
+                    try:
+                        port = json.load(open(p1_ready))["port"]
+                    except (OSError, ValueError, KeyError):
+                        time.sleep(0.2)
+                        continue
+                try:
+                    _, _, body = _get(f"http://127.0.0.1:{port}/metrics")
+                    assert "simclr_train_imgs_per_sec" in body
+                    scrapes[0] += 1
+                except (urllib.error.URLError, OSError):
+                    pass
+                time.sleep(0.1)
+
+        summary, observed_syncs = _run_pretrain_counting_syncs(
+            base + [
+                f"experiment.save_dir={obs_dir}",
+                f"telemetry.ready_file={ready}",
+            ],
+            monkeypatch,
+            scrape=scrape,
+        )
+        assert scrapes[0] > 0, "no scrape landed on the process-1 exporter"
+        assert observed_syncs == baseline_syncs
+        assert summary["complete"] is True
+        # process 0's configured path was never claimed by this process,
+        # and the per-process file was removed on clean exit
+        assert not ready.exists()
+        assert not os.path.exists(p1_ready)
 
     def test_injected_crash_yields_merged_two_attempt_timeline(self, tmp_path):
         """Acceptance proof: hard-kill + auto-resume under the supervisor

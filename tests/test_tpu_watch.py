@@ -21,7 +21,7 @@ WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "overlap_async", "augment_bench", "multihost_dryrun",
-    "elastic_dryrun", "remat2048", "explore1024", "explore512",
+    "elastic_dryrun", "fleet_smoke", "remat2048", "explore1024", "explore512",
     "supervisor_smoke", "obs_smoke", "compile_audit", "superepoch",
     "serve_scale", "run_report",
 )
@@ -117,6 +117,18 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         '"unit": "bool", "outcome": "clean", "remesh_count": 2, '
         '"grow_back_count": 1, "hosts": [2, 1, 2], '
         '"parity": true, "max_loss_delta": 0.012}\';; esac',
+        # the fleet_smoke stage shares the orchestrator script but passes
+        # --fleet; its done marker demands merged fleet gauges labeled for
+        # BOTH hosts, the straggler-skew gauge, and no error field (the
+        # script also exits 0 on error) — the gauge lines mirror what the
+        # orchestrator's live-scrape watcher prints as evidence samples
+        'case "$*" in *multihost_dryrun.py\\ --fleet) '
+        'echo \'{"metric": "fleet_smoke", "value": 1.0, "unit": "bool", '
+        '"outcome": "clean", "scrapes": 14, "skew_ratio": 1.3, '
+        '"summary_embeds_fleet": true}\'; '
+        'echo \'simclr_fleet_imgs_per_sec{host="0"} 100.0\'; '
+        'echo \'simclr_fleet_imgs_per_sec{host="1"} 80.0\'; '
+        "echo 'simclr_fleet_step_time_skew_ratio 1.3';; esac",
         # the supervisor_smoke stage greps its stdout for a clean outcome
         # with at least one resume (an uncrashed run also exits 0)
         'case "$*" in *simclr_tpu.supervisor*) '
@@ -409,6 +421,47 @@ def test_elastic_marker_requires_clean_outcome_with_a_remesh(tmp_path):
     r, state, log = _run_oneshot(tmp_path)
     assert "elastic_dryrun" not in _done(state)
     assert (state / "elastic_dryrun.fails").exists()
+
+
+def test_fleet_marker_requires_both_hosts_and_skew_gauge(tmp_path):
+    """The fleet orchestrator exits 0 even on failure, so the done marker
+    must demand the live merge evidence: fleet gauges labeled for BOTH
+    hosts AND the straggler-skew gauge AND no error field. A scrape that
+    only ever saw host 0 proves nothing about the cross-host merge."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '{host="1"} 80.0', '{host="0"} 80.0'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "fleet_smoke" not in _done(state)
+    assert (state / "fleet_smoke.fails").exists()
+    assert "stage fleet_smoke FAILED" in log.read_text()
+    # the dryruns sharing the script must be untouched
+    assert "multihost_dryrun" in _done(state)
+    assert "elastic_dryrun" in _done(state)
+
+    # second contract: both hosts labeled but the skew gauge never rendered
+    # (the collector would only skip it when a host's step_time is absent)
+    stub.write_text(stub.read_text()
+                    .replace('{host="0"} 80.0', '{host="1"} 80.0')
+                    .replace('simclr_fleet_step_time_skew_ratio 1.3',
+                             'skew gauge never rendered'))
+    (state / "fleet_smoke.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "fleet_smoke" not in _done(state)
+    assert (state / "fleet_smoke.fails").exists()
+
+    # third contract: the last-ditch error payload also exits 0
+    stub.write_text(stub.read_text()
+                    .replace('skew gauge never rendered',
+                             'simclr_fleet_step_time_skew_ratio 1.3')
+                    .replace('"summary_embeds_fleet": true}',
+                             '"summary_embeds_fleet": false, '
+                             '"error": "fleet evidence incomplete"}'))
+    (state / "fleet_smoke.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "fleet_smoke" not in _done(state)
+    assert (state / "fleet_smoke.fails").exists()
 
 
 def test_supervisor_marker_requires_an_actual_resume(tmp_path):

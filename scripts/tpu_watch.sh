@@ -54,7 +54,7 @@ MAX_STAGE_FAILS=3
 # chip lock — proves the pod code path on the host), then the remaining
 # step matrices, and last the supervisor kill/resume smoke (fault
 # tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench overlap_async augment_bench multihost_dryrun elastic_dryrun fleet_smoke remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench overlap_async augment_bench multihost_dryrun elastic_dryrun fleet_smoke cosched_smoke remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -310,6 +310,30 @@ run_stage() {
             if [ "$rc" -eq 0 ]; then
                 grep -q 'host="1"' "$out" \
                     && grep -q 'simclr_fleet_step_time_skew_ratio' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        cosched_smoke)
+            # train+serve co-scheduler e2e (scripts/cosched_smoke.py): a
+            # 2-process CPU training run co-scheduled with the serve tier
+            # must hot-reload at least TWO checkpoint generations, lend a
+            # training host to serving under a synthetic load burst
+            # (reallocate shrink) and take it back when traffic ebbs, keep
+            # /v1/embed and /v1/neighbors on the SAME generation, and
+            # match an uninterrupted reference's loss trajectory. CPU-only
+            # like multihost_dryrun — no chip lock. The script exits 0
+            # even on error, so the done marker requires >= 2 swaps, >= 1
+            # reallocation, the generation-consistency probe, and no
+            # error field.
+            out="$STATE/cosched_smoke.out"
+            timeout "$(stage_timeout 1800)" python scripts/cosched_smoke.py \
+                > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -Eq '"swaps": [2-9]' "$out" \
+                    && grep -Eq '"reallocations": [1-9]' "$out" \
+                    && grep -q '"generation_consistent": true' "$out" \
                     && ! grep -q '"error"' "$out"
                 rc=$?
             fi ;;

@@ -714,7 +714,9 @@ def check_save_features_conf(cfg: Config) -> None:
     _require(cfg.experiment.target_dir != "DUMMY-PATH", "experiment.target_dir must be set")
 
 
-def check_serve_conf(cfg: Config) -> None:
+def check_serve_conf(
+    cfg: Config, *, require_checkpoint_source: bool = True
+) -> None:
     s = cfg.select("serve")
     _require(s is not None, "serve config group missing (load_config('serve'))")
     _require(int(s.max_batch) > 0, "serve.max_batch must be positive")
@@ -765,10 +767,93 @@ def check_serve_conf(cfg: Config) -> None:
         metric in ("dot", "cosine"),
         f"serve.neighbors_metric must be dot|cosine, got {metric!r}",
     )
-    # one of the checkpoint sources must be real
-    if not s.get("checkpoint"):
+    # one of the checkpoint sources must be real — except under the
+    # co-scheduler, which serves random generation-0 weights and hot-reloads
+    # checkpoints as training writes them (check_cosched_conf)
+    if require_checkpoint_source and not s.get("checkpoint"):
         _require(
             bool(cfg.experiment.target_dir)
             and cfg.experiment.target_dir != "DUMMY-PATH",
             "set experiment.target_dir (checkpoint run dir) or serve.checkpoint",
         )
+
+
+def check_cosched_conf(cfg: Config) -> None:
+    """Validate the co-scheduler surface (``cosched.*`` plus the serve,
+    supervisor, and telemetry knobs it composes — ``conf/cosched.yaml``).
+    The serve tier starts on random generation-0 weights and hot-reloads
+    each checkpoint the training run writes, so unlike the standalone
+    server no pre-existing checkpoint source is required."""
+    check_serve_conf(cfg, require_checkpoint_source=False)
+    _check_supervisor_conf(cfg)
+    _check_telemetry_conf(cfg)
+    c = cfg.select("cosched")
+    _require(c is not None, "cosched config group missing (load_config('cosched'))")
+    serve_devices = cfg.select("cosched.serve_devices", 1)
+    _require(
+        isinstance(serve_devices, int) and not isinstance(serve_devices, bool)
+        and serve_devices >= 1,
+        "cosched.serve_devices must be an int >= 1 (local devices reserved "
+        f"for the serve tier), got {serve_devices!r}",
+    )
+    max_serve = cfg.select("cosched.max_serve_devices", serve_devices)
+    _require(
+        isinstance(max_serve, int) and not isinstance(max_serve, bool)
+        and max_serve >= serve_devices,
+        "cosched.max_serve_devices must be an int >= cosched.serve_devices "
+        f"(ceiling the elastic grow can reach), got {max_serve!r}",
+    )
+    poll = cfg.select("cosched.reload_poll_s", 2.0)
+    _require(
+        isinstance(poll, (int, float)) and not isinstance(poll, bool)
+        and 0 < poll <= 3600,
+        f"cosched.reload_poll_s must be in (0, 3600] seconds between "
+        f"checkpoint-watch passes, got {poll!r}",
+    )
+    corpus_images = cfg.select("cosched.corpus_images", 0)
+    _require(
+        isinstance(corpus_images, int) and not isinstance(corpus_images, bool)
+        and 0 <= corpus_images <= 1_000_000,
+        "cosched.corpus_images must be an int in [0, 1000000] retrieval "
+        f"corpus rows (0 = no /v1/neighbors), got {corpus_images!r}",
+    )
+    reembed = cfg.select("cosched.reembed_batch", 256)
+    _require(
+        isinstance(reembed, int) and not isinstance(reembed, bool)
+        and 1 <= reembed <= 4096,
+        f"cosched.reembed_batch must be an int in [1, 4096] rows per "
+        f"re-embed forward, got {reembed!r}",
+    )
+    realloc = cfg.select("cosched.reallocation", True)
+    _require(
+        isinstance(realloc, bool),
+        f"cosched.reallocation must be a boolean (true|false), got {realloc!r}",
+    )
+    high = cfg.select("cosched.pressure_high", 0.75)
+    low = cfg.select("cosched.pressure_low", 0.1)
+    for name, v in (("pressure_high", high), ("pressure_low", low)):
+        _require(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            and 0.0 <= v <= 1.0,
+            f"cosched.{name} must be in [0.0, 1.0] (fraction of "
+            f"serve.queue_depth), got {v!r}",
+        )
+    _require(
+        low < high,
+        f"cosched.pressure_low ({low!r}) must be < cosched.pressure_high "
+        f"({high!r}) — the hysteresis band cannot be empty",
+    )
+    sustain = cfg.select("cosched.pressure_sustain_s", 10.0)
+    _require(
+        isinstance(sustain, (int, float)) and not isinstance(sustain, bool)
+        and 0 <= sustain <= 3600,
+        "cosched.pressure_sustain_s must be in [0, 3600] seconds of "
+        f"sustained pressure before reallocating, got {sustain!r}",
+    )
+    cooldown = cfg.select("cosched.realloc_cooldown_s", 30.0)
+    _require(
+        isinstance(cooldown, (int, float)) and not isinstance(cooldown, bool)
+        and 0 <= cooldown <= 86400,
+        "cosched.realloc_cooldown_s must be in [0, 86400] seconds between "
+        f"reallocation direction changes, got {cooldown!r}",
+    )

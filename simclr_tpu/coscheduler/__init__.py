@@ -1,0 +1,44 @@
+"""Continuous train+serve co-scheduler (``python -m simclr_tpu.coscheduler``).
+
+Runs contrastive pretraining and the embedding serve tier as ONE
+supervised system on one device pod: the serve tier starts on random
+generation-0 weights, hot-reloads every sha256-verified checkpoint the
+run writes with a zero-downtime generation swap (and a generation-tagged
+retrieval-corpus re-embed), and elastic reallocation moves a host between
+the training mesh and the serve tier as queue pressure demands. See
+``docs/SERVING.md`` ("Continuous reload") and ``conf/cosched.yaml``.
+
+Import surface: :class:`ReallocationPolicy` (jax-free) is imported
+eagerly; the jax-heavy :class:`CoScheduler` / :class:`ReloadManager` load
+lazily so config validation and policy unit tests stay cheap.
+"""
+
+from __future__ import annotations
+
+from simclr_tpu.coscheduler.policy import (
+    RELEASE,
+    SHRINK,
+    ReallocationPolicy,
+    pressure_of,
+)
+
+__all__ = [
+    "RELEASE",
+    "SHRINK",
+    "CoScheduler",
+    "ReallocationPolicy",
+    "ReloadManager",
+    "pressure_of",
+]
+
+
+def __getattr__(name: str):
+    if name == "CoScheduler":
+        from simclr_tpu.coscheduler.core import CoScheduler
+
+        return CoScheduler
+    if name == "ReloadManager":
+        from simclr_tpu.coscheduler.reload import ReloadManager
+
+        return ReloadManager
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

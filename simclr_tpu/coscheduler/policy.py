@@ -1,0 +1,123 @@
+"""Elastic train/serve reallocation policy: pressure-driven hysteresis.
+
+The decision half of the co-scheduler's device reallocation. The core
+samples the serve tier's queue pressure (batcher depth as a fraction of
+``serve.queue_depth``, saturated to 1.0 whenever requests were 429-shed
+since the last sample) and feeds it here; this state machine decides WHEN
+to lend a training host to the serve tier and when to give it back.
+Deliberately pure and clock-injected (``observe(pressure, now)``) so the
+policy is unit-testable without threads, sockets, or sleeps.
+
+Two guards keep the split from flapping — the failure mode that would turn
+elastic reallocation into a net loss (every direction change costs a
+training drain + remesh):
+
+  * **sustain**: pressure must stay past the threshold for
+    ``pressure_sustain_s`` continuously; a single burst that drains on its
+    own never moves devices. Samples inside the hysteresis band
+    (``low < p < high``) reset both timers.
+  * **cooldown**: ``realloc_cooldown_s`` must elapse between direction
+    changes, bounding the worst-case remesh rate no matter how the load
+    oscillates.
+"""
+
+from __future__ import annotations
+
+SHRINK = "shrink"     # lend one training host to the serve tier
+RELEASE = "release"   # give every lent host back to training
+
+
+def pressure_of(queue_depth: int, queue_capacity: int, rejected_delta: int = 0) -> float:
+    """Normalize the serve tier's load into [0, 1].
+
+    Queue depth over capacity, saturated to 1.0 if ANY request was shed
+    with 429 since the last sample — backpressure rejections mean the
+    queue ceiling was hit between samples even if the depth looks low now.
+    """
+    if rejected_delta > 0:
+        return 1.0
+    if queue_capacity <= 0:
+        return 0.0
+    return min(1.0, max(0, queue_depth) / float(queue_capacity))
+
+
+class ReallocationPolicy:
+    """Two-state (idle | lent) hysteresis over a pressure signal.
+
+    ``observe`` returns :data:`SHRINK` exactly once per idle->lent
+    transition and :data:`RELEASE` once per lent->idle; the caller executes
+    the move (or calls :meth:`cancel` if it could not).
+    """
+
+    def __init__(
+        self,
+        *,
+        high: float = 0.75,
+        low: float = 0.1,
+        sustain_s: float = 10.0,
+        cooldown_s: float = 30.0,
+        enabled: bool = True,
+    ):
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError(
+                f"need 0 <= low < high <= 1, got low={low!r} high={high!r}"
+            )
+        self.high = float(high)
+        self.low = float(low)
+        self.sustain_s = float(sustain_s)
+        self.cooldown_s = float(cooldown_s)
+        self.enabled = bool(enabled)
+        self.state = "idle"
+        self._above_since: float | None = None
+        self._below_since: float | None = None
+        self._last_change: float | None = None
+
+    def _cooled(self, now: float) -> bool:
+        return (
+            self._last_change is None
+            or now - self._last_change >= self.cooldown_s
+        )
+
+    def observe(self, pressure: float, now: float) -> str | None:
+        """Feed one pressure sample; returns SHRINK, RELEASE, or None."""
+        if not self.enabled:
+            return None
+        if pressure >= self.high:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if (
+                self.state == "idle"
+                and now - self._above_since >= self.sustain_s
+                and self._cooled(now)
+            ):
+                self.state = "lent"
+                self._last_change = now
+                self._above_since = None
+                return SHRINK
+        elif pressure <= self.low:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if (
+                self.state == "lent"
+                and now - self._below_since >= self.sustain_s
+                and self._cooled(now)
+            ):
+                self.state = "idle"
+                self._last_change = now
+                self._below_since = None
+                return RELEASE
+        else:
+            # hysteresis band: neither timer accumulates
+            self._above_since = None
+            self._below_since = None
+        return None
+
+    def cancel(self, now: float) -> None:
+        """Undo the transition ``observe`` just returned because the move
+        could not be executed (training mesh already at one host, serve
+        tier at ``max_serve_devices``, ...). The cooldown clock still
+        advances so a refused move is not retried every sample."""
+        self.state = "idle" if self.state == "lent" else "lent"
+        self._last_change = now

@@ -1,0 +1,282 @@
+"""Checkpoint watcher: zero-downtime generation swaps into the serve tier.
+
+The hot-reload half of the co-scheduler. Training writes sha256-verified
+epoch checkpoints into the run dir; this manager watches for them and
+swaps each one into every serve replica without dropping, tearing, or
+recompiling anything:
+
+  1. **verify** — the sidecar digest must exist AND match. A checkpoint
+     with no sidecar is an in-progress or legacy save (the sidecar is the
+     commit signal) and is silently skipped until it appears; a digest
+     mismatch (torn write, injected corruption — ``supervisor/faults.py``)
+     rejects the swap: ``swap_rejected`` event +
+     ``simclr_serve_swap_rejected_total``, prior generation keeps serving
+     bitwise-unchanged, and the path is never retried.
+  2. **stage** — pack the new variables device-side on EVERY replica
+     (``EmbedEngine.stage_weights``): shape/dtype/structure-identical to
+     the committed storage by contract, so the warm per-bucket jit cache
+     serves the new weights with ZERO recompiles (an incompatible
+     checkpoint raises and rejects the swap before any replica changes).
+  3. **re-embed** — run the retrieval corpus through the STAGED weights on
+     the primary replica (``embed_with`` — same compiled bucket programs,
+     no serving metrics touched), so the fresh index exists before the
+     swap is visible.
+  4. **commit** — one atomic tuple assignment per replica; in-flight
+     requests finish on the weights they already read, subsequent ones
+     read generation N+1.
+  5. **corpus swap** — publish a new generation-tagged
+     :class:`~simclr_tpu.serve.retrieval.NeighborIndex` via
+     ``EmbedServer.swap_index``, so ``/v1/neighbors`` answers from the
+     same encoder generation as ``/v1/embed`` (both responses carry their
+     generation headers; ``/healthz`` shows both numbers).
+
+Any failure anywhere in 1-4 leaves every replica on the prior generation —
+stage-all-then-commit-all means the pool can never serve a mixed or torn
+weight set.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from simclr_tpu.utils.checkpoint import (
+    CheckpointCorruptionError,
+    epoch_of,
+    list_checkpoints,
+    verify_checkpoint,
+)
+
+logger = logging.getLogger("simclr_tpu.coscheduler")
+
+
+def _default_load(path: str) -> dict:
+    from simclr_tpu.eval import load_model_variables
+
+    return load_model_variables(path)
+
+
+class ReloadManager:
+    """Watch ``save_dir`` for verified checkpoints; swap them into ``pool``.
+
+    ``corpus_images`` (``(n, H, W, C)`` uint8, or None) is the retrieval
+    corpus source: each committed generation re-embeds it and swaps the
+    resulting index into ``server``. ``load_fn`` is injectable for tests
+    (defaults to the blessed ``eval.load_model_variables`` restore path).
+    """
+
+    def __init__(
+        self,
+        pool,
+        *,
+        save_dir: str,
+        server=None,
+        events=None,
+        metrics=None,
+        corpus_images: np.ndarray | None = None,
+        reembed_batch: int = 256,
+        neighbors_metric: str = "dot",
+        poll_s: float = 2.0,
+        load_fn=None,
+    ):
+        self.pool = pool
+        self.save_dir = str(save_dir)
+        self.server = server
+        self.events = events
+        self.metrics = metrics
+        self.corpus_images = corpus_images
+        self.reembed_batch = int(reembed_batch)
+        self.neighbors_metric = neighbors_metric
+        self.poll_s = float(poll_s)
+        self._load = load_fn if load_fn is not None else _default_load
+        # serialized swap/attach state: the policy thread resyncs freshly
+        # grown replicas through the same lock the watcher swaps under, so
+        # a replica can never join the pool on a half-superseded generation
+        self.lock = threading.Lock()
+        self.swapped_epoch = -1
+        self.swap_count = 0
+        self.rejected_count = 0
+        self._rejected: set[str] = set()
+        self._ckpt_mtime: float | None = None
+        # host copy of the SERVING generation's variables — what a replica
+        # grown by elastic reallocation boots from (None until first swap;
+        # the core seeds it with the generation-0 init variables)
+        self.current_variables: dict | None = None
+        if metrics is not None:
+            metrics.checkpoint_staleness_seconds.set_fn(self._staleness)
+
+    # -- observability -------------------------------------------------------
+    @property
+    def generation(self) -> int:
+        return self.pool.weights_generation
+
+    def _staleness(self) -> float:
+        """Seconds since the serving generation's checkpoint was written
+        (0 until the first swap — generation 0 has no checkpoint)."""
+        return time.time() - self._ckpt_mtime if self._ckpt_mtime else 0.0
+
+    # -- corpus --------------------------------------------------------------
+    def _reembed(self, engine, staged) -> np.ndarray:
+        batch = max(1, min(self.reembed_batch, engine.max_batch))
+        images = self.corpus_images
+        return np.concatenate(
+            [
+                engine.embed_with(staged, images[i : i + batch])
+                for i in range(0, images.shape[0], batch)
+            ]
+        )
+
+    def _build_index(self, embeddings: np.ndarray, generation: int):
+        from simclr_tpu.serve.retrieval import NeighborIndex
+
+        return NeighborIndex(
+            embeddings,
+            metric=self.neighbors_metric,
+            max_queries=self.pool.primary.max_batch,
+            sentry=self.pool.primary.sentry,
+            metrics=self.metrics,
+            generation=generation,
+        )
+
+    def publish_index(self, embeddings: np.ndarray, generation: int) -> None:
+        """Build + swap a generation-tagged index (also used by the core
+        for the generation-0 corpus before traffic starts)."""
+        if self.server is not None:
+            self.server.swap_index(self._build_index(embeddings, generation))
+        if self.metrics is not None:
+            self.metrics.corpus_generation.set(generation)
+
+    def bootstrap_corpus(self) -> None:
+        """Embed + publish the startup corpus from the committed variables
+        (a staged view of the weights already serving — no commit, no
+        generation change), so ``/v1/neighbors`` works before the first
+        checkpoint ever lands."""
+        if self.corpus_images is None or self.current_variables is None:
+            return
+        with self.lock:
+            engine = self.pool.primary
+            staged = engine.stage_weights(self.current_variables)
+            embeddings = self._reembed(engine, staged)
+            self.publish_index(embeddings, self.pool.weights_generation)
+
+    # -- swap protocol -------------------------------------------------------
+    def poll_once(self) -> bool:
+        """One watch pass; True if a new generation was committed."""
+        candidates = [
+            p
+            for p in list_checkpoints(self.save_dir)
+            if epoch_of(p) > self.swapped_epoch and p not in self._rejected
+        ]
+        for path in reversed(candidates):  # newest verified checkpoint wins
+            try:
+                verified = verify_checkpoint(path)
+            except CheckpointCorruptionError as e:
+                self._reject(path, f"digest mismatch: {e}")
+                continue
+            if not verified:
+                # no sidecar: the save has not committed yet (or predates
+                # integrity sidecars) — wait, don't reject
+                continue
+            return self.swap_to(path)
+        return False
+
+    def swap_to(self, path: str) -> bool:
+        epoch = epoch_of(path)
+        with self.lock:
+            generation = self.pool.weights_generation + 1
+            try:
+                variables = self._load(path)
+                replicas = list(self.pool.replicas)
+                staged = [
+                    rep.engine.stage_weights(variables, checkpoint_path=path)
+                    for rep in replicas
+                ]
+                embeddings = (
+                    self._reembed(replicas[0].engine, staged[0])
+                    if self.corpus_images is not None
+                    else None
+                )
+            except Exception as e:  # noqa: BLE001 - ANY failed swap must
+                # leave the prior generation serving, not kill the watcher
+                self._reject(path, f"{type(e).__name__}: {e}")
+                return False
+            for rep, st in zip(replicas, staged):
+                rep.engine.commit(st, generation=generation)
+            self.current_variables = variables
+            if embeddings is not None:
+                self.publish_index(embeddings, generation)
+        self.swapped_epoch = epoch
+        self.swap_count += 1
+        try:
+            self._ckpt_mtime = os.path.getmtime(path)
+        except OSError:
+            self._ckpt_mtime = time.time()
+        if self.metrics is not None:
+            self.metrics.weights_generation.set(generation)
+            self.metrics.weight_swaps_total.inc()
+        if self.events is not None:
+            self.events.emit(
+                "swap",
+                epoch=epoch,
+                generation=generation,
+                path=path,
+                replicas=len(self.pool.replicas),
+            )
+        logger.info(
+            "hot-swapped epoch %d checkpoint as generation %d across %d "
+            "replica(s)", epoch, generation, len(self.pool.replicas),
+        )
+        return True
+
+    def _reject(self, path: str, reason: str) -> None:
+        self._rejected.add(path)
+        self.rejected_count += 1
+        if self.metrics is not None:
+            self.metrics.swap_rejected_total.inc()
+        if self.events is not None:
+            self.events.emit(
+                "swap_rejected",
+                epoch=epoch_of(path),
+                path=path,
+                reason=reason,
+                serving_generation=self.pool.weights_generation,
+            )
+        logger.warning(
+            "swap rejected for %s (%s); generation %d keeps serving",
+            path, reason, self.pool.weights_generation,
+        )
+
+    # -- elastic grow support ------------------------------------------------
+    def resync_engine(self, engine) -> None:
+        """Bring a freshly built replica onto the SERVING generation before
+        it joins the pool. Under the swap lock: stages the current host
+        variables (if any swap has happened) and commits them with the
+        pool's generation, so ``weights_generation`` (a min over replicas)
+        never regresses when the tier grows."""
+        with self.lock:
+            generation = self.pool.weights_generation
+            if self.current_variables is not None:
+                staged = engine.stage_weights(self.current_variables)
+                engine.commit(staged, generation=generation)
+            else:
+                engine.generation = generation
+
+    # -- loop ----------------------------------------------------------------
+    def run(self, stop: threading.Event) -> None:
+        """Poll until ``stop`` is set, then one final pass so the terminal
+        epoch's checkpoint (written just before training exits) still
+        ships."""
+        while not stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # pragma: no cover - watcher must survive
+                logger.exception("checkpoint watch pass failed; retrying")
+            stop.wait(self.poll_s)
+        try:
+            self.poll_once()
+        except Exception:  # pragma: no cover
+            logger.exception("final checkpoint watch pass failed")

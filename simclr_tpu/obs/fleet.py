@@ -196,7 +196,10 @@ class FleetCollector:
         self.save_dir = save_dir
         self.nprocs = int(nprocs)
         self.train_ready_file = train_ready_file
-        self.serve_ready_files = tuple(serve_ready_files)
+        # explicit listing (telemetry.fleet_serve_ready_files) plus any
+        # serve*.ready file that appears in the run dir later — co-scheduled
+        # serve replicas are discovered automatically each scrape pass
+        self.serve_ready_files = list(serve_ready_files)
         self.poll_s = float(poll_s)
         self.stale_after_s = float(stale_after_s)
         self.timeout_s = float(timeout_s)
@@ -296,8 +299,32 @@ class FleetCollector:
             state.snapshot = snapshot
         state.scraped_at = time.monotonic()
 
+    def _discover_serve_ready(self) -> None:
+        """Adopt any ``serve*.ready`` file in the run dir into the scrape
+        set. Co-scheduled serve replicas publish their endpoints next to the
+        train telemetry ready files, so the fleet view picks them up with
+        no ``telemetry.fleet_serve_ready_files`` listing. Copy-on-write:
+        snapshot/render threads iterate these structures concurrently."""
+        try:
+            names = sorted(os.listdir(self.save_dir))
+        except OSError:
+            return
+        known = {os.path.abspath(p) for p in self.serve_ready_files}
+        for name in names:
+            if not (name.startswith("serve") and name.endswith(".ready")):
+                continue
+            path = os.path.join(self.save_dir, name)
+            if os.path.abspath(path) in known:
+                continue
+            self.serve_ready_files = [*self.serve_ready_files, path]
+            self._replicas = {
+                **self._replicas,
+                len(self.serve_ready_files) - 1: _EndpointState(),
+            }
+
     def scrape_once(self) -> None:
         """One pass over every endpoint (also what the poll thread runs)."""
+        self._discover_serve_ready()
         for rank, state in self._hosts.items():
             ready = (
                 telemetry_ready_path(self.train_ready_file, rank)
@@ -305,10 +332,9 @@ class FleetCollector:
                 else None
             )
             self._scrape_endpoint(state, ready, want_snapshot=True)
-        for idx, state in self._replicas.items():
-            self._scrape_endpoint(
-                state, self.serve_ready_files[idx], want_snapshot=False
-            )
+        files = self.serve_ready_files
+        for idx, state in list(self._replicas.items()):
+            self._scrape_endpoint(state, files[idx], want_snapshot=False)
         with self._lock:
             self._scrapes += 1
 

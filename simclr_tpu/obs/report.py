@@ -63,7 +63,12 @@ _COUNTED_EVENTS = {
     "host_lost": "hosts_lost",
     "remesh": "remeshes",
     "grow_back": "grow_backs",
+    "swap": "swaps",
+    "swap_rejected": "swap_rejections",
+    "reallocate": "reallocations",
 }
+
+COSCHED_SUMMARY_NAME = "cosched_summary.json"
 
 
 def _load_json(path: str) -> dict | None:
@@ -221,6 +226,35 @@ def build_report(
         telemetry = heartbeat["telemetry"]
     supervisor = _load_json(os.path.join(run_dir, SUMMARY_NAME))
 
+    # co-scheduled serve plane: checkpoint hot-swaps, rejected swaps, and
+    # train/serve device reallocations interleave with the training events
+    # in the same run dir — the combined train+serve post-mortem
+    cosched = _load_json(os.path.join(run_dir, COSCHED_SUMMARY_NAME))
+    swap_events = [e for e in events if e.get("event") == "swap"]
+    reject_events = [e for e in events if e.get("event") == "swap_rejected"]
+    realloc_events = [e for e in events if e.get("event") == "reallocate"]
+    serve = None
+    if swap_events or reject_events or realloc_events or cosched:
+        serve = {
+            "swaps": len(swap_events),
+            "swap_rejections": len(reject_events),
+            "reallocations": sum(
+                1 for e in realloc_events if e.get("direction") == "shrink"
+            ),
+            "releases": sum(
+                1 for e in realloc_events if e.get("direction") == "release"
+            ),
+            "serving_generation": (
+                swap_events[-1].get("generation")
+                if swap_events
+                else (cosched or {}).get("serving_generation", 0)
+            ),
+            "last_swap_epoch": (
+                swap_events[-1].get("epoch") if swap_events else None
+            ),
+            "serve_replicas": (cosched or {}).get("serve_replicas"),
+        }
+
     # fleet view: one row per heartbeat.p<i>.json (every host beats), the
     # skew/slowest verdict from the supervisor's embedded FleetCollector
     # snapshot when present, recomputed from the beats otherwise
@@ -279,6 +313,8 @@ def build_report(
         "slowest_host": slowest,
         "outcome": supervisor.get("outcome") if supervisor else None,
         "supervisor": supervisor,
+        "serve": serve,
+        "cosched": cosched,
         "heartbeat": heartbeat,
         "telemetry": telemetry,
         "measured_imgs_per_sec_per_chip": measured,
@@ -397,6 +433,24 @@ def render_report(report: dict) -> str:
                 "timeline: python -m simclr_tpu.obs.timeline "
                 f"{report['run_dir']}"
             )
+    serve = report.get("serve")
+    if serve:
+        reject_part = (
+            f" REJECTED={serve['swap_rejections']}"
+            if serve.get("swap_rejections") else ""
+        )
+        replica_part = (
+            f" replicas={serve['serve_replicas']}"
+            if serve.get("serve_replicas") is not None else ""
+        )
+        lines.append(
+            f"serve: swaps={serve['swaps']}{reject_part} "
+            f"generation={serve.get('serving_generation')} "
+            f"reallocations={serve['reallocations']} "
+            f"(released {serve['releases']}){replica_part}"
+        )
+        if serve.get("last_swap_epoch") is not None:
+            lines.append(f"  last swap: epoch {serve['last_swap_epoch']}")
     telemetry = report.get("telemetry") or {}
     if telemetry.get("exposed_comm_ms") is not None:
         # step time beyond roofline compute — the wire the scheduler did NOT

@@ -52,8 +52,11 @@ PID_HOST_BASE = 10  # host slot i renders as pid 10 + i
 # supervisor/lifecycle event kinds (everything the trainers do NOT emit)
 _LIFECYCLE = {
     "run_start", "run_end", "outcome", "child_exit", "restart", "hang",
-    "remesh", "grow_back", "topology_change",
+    "remesh", "grow_back", "topology_change", "reallocate",
 }
+
+# co-scheduler serve-plane kinds that don't carry the "serve" prefix
+_SERVE_EVENTS = {"swap", "swap_rejected"}
 
 
 def _num(value, default=None):
@@ -85,6 +88,12 @@ def _event_name(event: dict) -> str:
         return f"grow_back {hosts}" if hosts else "grow_back"
     if kind == "outcome":
         return f"outcome: {event.get('outcome', '?')}"
+    if kind == "swap":
+        return f"swap e{event.get('epoch', '?')} → gen {event.get('generation', '?')}"
+    if kind == "swap_rejected":
+        return f"swap_rejected e{event.get('epoch', '?')}"
+    if kind == "reallocate":
+        return f"reallocate ({event.get('direction', '?')})"
     return str(kind)
 
 
@@ -93,7 +102,7 @@ def _track_for(event: dict) -> int:
     kind = str(event.get("event", ""))
     if kind == "host_lost" and _num(event.get("host")) is not None:
         return PID_HOST_BASE + int(event["host"])
-    if kind.startswith("serve"):
+    if kind.startswith("serve") or kind in _SERVE_EVENTS:
         return PID_SERVE
     if kind in _LIFECYCLE:
         return PID_SUPERVISOR

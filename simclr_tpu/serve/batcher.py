@@ -108,24 +108,64 @@ class DynamicBatcher:
         self._q: queue.Queue[_Pending] = queue.Queue(maxsize=queue_depth)
         self._closed = threading.Event()   # stop intake; workers drain then exit
         self._abort = threading.Event()    # stop now; queued futures fail
+        # per-replica retire events (elastic membership): setting one makes
+        # that replica's worker exit between batches without touching the
+        # global lifecycle — the other workers keep draining the queue
+        self._retire: dict[int, threading.Event] = {}
+        self._worker_lock = threading.Lock()
         if pool is None:
             self._workers = [
                 threading.Thread(target=self._run, name="serve-batcher", daemon=True)
             ]
         else:
             self._workers = [
-                threading.Thread(
-                    target=self._run,
-                    args=(rep,),
-                    name=f"serve-batcher-r{rep.rid}",
-                    daemon=True,
-                )
-                for rep in pool.replicas
+                self._make_worker(rep) for rep in pool.replicas
             ]
         for w in self._workers:
             w.start()
         if metrics is not None:
             metrics.queue_depth.set_fn(self._q.qsize)
+
+    def _make_worker(self, rep) -> threading.Thread:
+        self._retire[rep.rid] = threading.Event()
+        return threading.Thread(
+            target=self._run,
+            args=(rep,),
+            name=f"serve-batcher-r{rep.rid}",
+            daemon=True,
+        )
+
+    # -- elastic membership (coscheduler reallocation) ----------------------
+    def add_worker(self, rep) -> None:
+        """Start a coalescing worker for a replica added to the pool after
+        construction (``ReplicaPool.add_replica``). The new worker pulls
+        from the same shared queue — dispatch stays least-loaded."""
+        with self._worker_lock:
+            w = self._make_worker(rep)
+            self._workers.append(w)
+        w.start()
+
+    def retire_worker(self, rid: int, timeout: float = 30.0) -> bool:
+        """Stop replica ``rid``'s worker between batches.
+
+        The worker finishes any batch it already took (no accepted request
+        is dropped), then exits; queued items it never took stay for the
+        remaining workers. Returns True once the worker has exited."""
+        try:
+            self._retire[rid].set()
+        except KeyError:
+            raise KeyError(f"no worker for replica {rid}") from None
+        with self._worker_lock:
+            workers = list(self._workers)
+        deadline = time.perf_counter() + timeout
+        for w in workers:
+            if w.name == f"serve-batcher-r{rid}":
+                w.join(timeout=max(0.0, deadline - time.perf_counter()))
+                if not w.is_alive():
+                    with self._worker_lock:
+                        self._workers = [x for x in self._workers if x is not w]
+                return not w.is_alive()
+        return True
 
     # -- producer side (HTTP handler threads) ------------------------------
     def submit(self, images: np.ndarray, trace=None) -> Future:
@@ -162,7 +202,12 @@ class DynamicBatcher:
     # -- consumer side (one worker thread per replica) ---------------------
     def _run(self, replica=None) -> None:
         carry: _Pending | None = None
+        retire = (
+            self._retire.get(replica.rid) if replica is not None else None
+        )
         while not self._abort.is_set():
+            if retire is not None and retire.is_set() and carry is None:
+                return  # retired between batches; queue stays for the others
             if carry is not None:
                 first, carry = carry, None
             else:
@@ -244,8 +289,12 @@ class DynamicBatcher:
         for p in batch:
             if replica is not None:
                 # stamped BEFORE set_result so the handler thread always
-                # sees it when the future resolves (X-Served-By header)
+                # sees them when the future resolves (X-Served-By /
+                # X-Weights-Generation headers)
                 p.future.replica_id = replica.rid
+                p.future.generation = getattr(
+                    replica.engine, "generation", None
+                )
             if p.trace is not None:
                 # spans are complete before the future resolves, so the
                 # handler thread reads a finished trace

@@ -57,6 +57,34 @@ class RequestTooLargeError(ValueError):
     """A single request carries more rows than the largest bucket."""
 
 
+class WeightsIncompatibleError(ValueError):
+    """Staged weights do not match the resident storage layout.
+
+    Raised by :meth:`EmbedEngine.stage_weights` when the new checkpoint's
+    packed param tree differs from the committed one in structure, shape,
+    or dtype — swapping it in would force a fresh XLA compile per bucket
+    (or worse, run a wrong program), so the swap is refused instead.
+    """
+
+
+class StagedWeights:
+    """A packed-and-device-resident weight set awaiting :meth:`commit`.
+
+    Produced by :meth:`EmbedEngine.stage_weights`; carries the same pytree
+    structure/shapes/dtypes as the committed storage, so the engine's
+    existing bucket programs run on it without recompiling. Holding one of
+    these costs a second resident weight copy on the device until it is
+    committed (then the old copy is dropped) or discarded.
+    """
+
+    __slots__ = ("params", "batch_stats", "checkpoint_path")
+
+    def __init__(self, params, batch_stats, checkpoint_path=None):
+        self.params = params
+        self.batch_stats = batch_stats
+        self.checkpoint_path = checkpoint_path
+
+
 def make_buckets(max_batch: int) -> tuple[int, ...]:
     """Power-of-two batch buckets up to ``max_batch`` (inclusive).
 
@@ -128,11 +156,19 @@ class EmbedEngine:
         # program — per-request device_put of the params would dominate the
         # forward at small batches. Committing to an explicit `device` pins
         # every bucket program there (jit follows committed arguments), so
-        # N engines over N devices run concurrently.
-        self._params, dequant, self._n_weight_elements = self._pack_params(
+        # N engines over N devices run concurrently. The (params,
+        # batch_stats) pair lives in ONE tuple attribute so hot-reload can
+        # swap both atomically under concurrent embeds — a reader never
+        # sees generation N params with generation N-1 batch stats.
+        packed, dequant, self._n_weight_elements = self._pack_params(
             variables["params"]
         )
-        self._batch_stats = self._put(variables.get("batch_stats", {}))
+        self._resident = (packed, self._put(variables.get("batch_stats", {})))
+        # weight-generation bookkeeping for zero-downtime hot-reload
+        # (coscheduler/reload.py): 0 = construction-time variables, each
+        # commit() increments. checkpoint_path names the committed source.
+        self.generation = 0
+        self.checkpoint_path = None
 
         def forward(params, batch_stats, images):
             x = to_float(images)
@@ -152,6 +188,14 @@ class EmbedEngine:
             self.warmup()
 
     # -- weight storage ----------------------------------------------------
+    @property
+    def _params(self):
+        return self._resident[0]
+
+    @property
+    def _batch_stats(self):
+        return self._resident[1]
+
     def _put(self, tree):
         if self.device is None:
             return jax.device_put(tree)
@@ -252,6 +296,85 @@ class EmbedEngine:
             + stats_bytes
         )
 
+    # -- hot-reload (zero-downtime generation swap) ------------------------
+    @staticmethod
+    def _storage_signature(tree):
+        """(treedef, [(shape, dtype)...]) of a packed tree — the identity a
+        staged weight set must share with the committed one for jit's
+        shape-keyed executable cache to serve it without recompiling."""
+        leaves, treedef = jax.tree.flatten(tree)
+        return treedef, [(tuple(l.shape), str(l.dtype)) for l in leaves]
+
+    def stage_weights(self, variables: dict, checkpoint_path=None) -> StagedWeights:
+        """Pack new checkpoint variables into a device-resident staged copy.
+
+        Runs the SAME packing path the constructor used (so int8 staging
+        yields the identical ``{"q","scales","exact"}`` layout the compiled
+        forward's dequant closure expects) and verifies the packed tree is
+        structure/shape/dtype-identical to the committed storage — the
+        precondition for every existing bucket program to run on it with
+        zero recompiles. A mismatched checkpoint (different architecture,
+        head dim, weights mode artifacts) raises
+        :class:`WeightsIncompatibleError` and leaves the engine untouched.
+
+        Thread-safe against concurrent ``embed`` calls: nothing the request
+        path reads is mutated until :meth:`commit`.
+        """
+        packed, _dequant, _n = self._pack_params(variables["params"])
+        batch_stats = self._put(variables.get("batch_stats", {}))
+        cur_params, cur_stats = self._resident
+        if self._storage_signature(packed) != self._storage_signature(cur_params):
+            raise WeightsIncompatibleError(
+                "staged params storage differs from the committed layout "
+                "(architecture/d/weights-mode mismatch); refusing a swap "
+                "that would recompile every bucket"
+            )
+        if self._storage_signature(batch_stats) != self._storage_signature(
+            cur_stats
+        ):
+            raise WeightsIncompatibleError(
+                "staged batch_stats differ from the committed layout; "
+                "refusing the swap"
+            )
+        return StagedWeights(packed, batch_stats, checkpoint_path)
+
+    def embed_with(self, staged: StagedWeights, images: np.ndarray) -> np.ndarray:
+        """Forward ``images`` through STAGED (uncommitted) weights.
+
+        Used by the co-scheduler to re-embed the retrieval corpus with the
+        incoming generation BEFORE it starts serving — the corpus swap and
+        the weight swap then land back-to-back, so ``/v1/neighbors`` never
+        mixes generations with ``/v1/embed``. Runs the same compiled bucket
+        programs (staged storage is shape-identical by construction), and
+        deliberately touches no serving metrics or spans: traffic
+        accounting belongs to the committed generation.
+        """
+        images = np.asarray(images)
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        if n < bucket:
+            images = np.concatenate(
+                [images, np.zeros((bucket - n, *self.input_shape), np.uint8)]
+            )
+        out = fetch(self._fwd(staged.params, staged.batch_stats, images))
+        return out[:n]
+
+    def commit(self, staged: StagedWeights, *, generation: int | None = None):
+        """Atomically swap the staged weights in as the serving generation.
+
+        One tuple-attribute assignment: every in-flight ``embed`` finishes
+        on the copy it already read, every subsequent one reads the new
+        pair — zero downtime, no torn (params, batch_stats) mix. The old
+        copy's device memory is released once its last reader returns.
+        """
+        self._resident = (staged.params, staged.batch_stats)
+        self.generation = (
+            self.generation + 1 if generation is None else int(generation)
+        )
+        if staged.checkpoint_path is not None:
+            self.checkpoint_path = staged.checkpoint_path
+        return self.generation
+
     # -- lifecycle ---------------------------------------------------------
     def warmup(self) -> dict[int, float]:
         """Compile every bucket before traffic; returns per-bucket seconds.
@@ -339,7 +462,10 @@ class EmbedEngine:
                 [images, np.zeros((bucket - n, *self.input_shape), np.uint8)]
             )
         t0 = time.perf_counter()
-        out = fetch(self._fwd(self._params, self._batch_stats, images))
+        # ONE read of the resident tuple: params and batch_stats are always
+        # the same generation even if commit() swaps mid-call
+        params, batch_stats = self._resident
+        out = fetch(self._fwd(params, batch_stats, images))
         done = time.perf_counter()
         if cold:
             # the compiling dispatch: its duration upper-bounds the compile.

@@ -76,6 +76,25 @@ class ServeMetrics:
         self.corpus_hbm_bytes = Gauge(
             "simclr_serve_corpus_hbm_bytes",
             "Row-sharded retrieval corpus bytes resident in device HBM")
+        # continuous-reload plane (coscheduler): generation/staleness of the
+        # weights the pool is serving, plus the swap outcome counters the
+        # chaos tests pin (a rejected swap must bump swap_rejected_total and
+        # NOTHING else)
+        self.weights_generation = Gauge(
+            "simclr_serve_weights_generation",
+            "Checkpoint generation the replica pool is serving (0 = startup weights)")
+        self.corpus_generation = Gauge(
+            "simclr_serve_corpus_generation",
+            "Encoder generation that embedded the resident retrieval corpus")
+        self.checkpoint_staleness_seconds = Gauge(
+            "simclr_serve_checkpoint_staleness_seconds",
+            "Seconds since the serving generation's checkpoint was written")
+        self.weight_swaps_total = Counter(
+            "simclr_serve_weight_swaps_total",
+            "Zero-downtime weight generation swaps committed to every replica")
+        self.swap_rejected_total = Counter(
+            "simclr_serve_swap_rejected_total",
+            "Checkpoint swaps refused (corrupt/unverified/incompatible); prior generation kept")
         # ReplicaPool for the {replica="N"}-labeled per-replica gauges;
         # attached by start_server when serving through a pool
         self._pool = None
@@ -107,6 +126,9 @@ class ServeMetrics:
                 self.client_disconnects_total,
                 self.neighbors_requests_total, self.neighbors_queries_total,
                 self.neighbors_latency_ms, self.corpus_hbm_bytes,
+                self.weights_generation, self.corpus_generation,
+                self.checkpoint_staleness_seconds,
+                self.weight_swaps_total, self.swap_rejected_total,
             )
         ]
         parts.append(

@@ -111,8 +111,47 @@ class ReplicaPool:
         if not engines:
             raise ValueError("ReplicaPool needs at least one engine")
         # engines keep whatever replica_id they were built with (None for a
-        # wrapped legacy single engine — its sentry names stay untagged)
+        # wrapped legacy single engine — its sentry names stay untagged).
+        # The list itself is copy-on-write under _lock: add/remove build a
+        # new list and swap the attribute, so render/healthz threads
+        # iterating a snapshot never see a half-mutated registry.
         self.replicas = [ReplicaState(i, e) for i, e in enumerate(engines)]
+        self._lock = threading.Lock()
+
+    # -- elastic membership (coscheduler reallocation) ----------------------
+    def add_replica(self, engine) -> "ReplicaState":
+        """Register a new engine (already built on its device) as the next
+        replica id. The caller owns starting a batcher worker for it
+        (``DynamicBatcher.add_worker``)."""
+        with self._lock:
+            rid = max((r.rid for r in self.replicas), default=-1) + 1
+            engine.replica_id = rid
+            rep = ReplicaState(rid, engine)
+            self.replicas = [*self.replicas, rep]
+        return rep
+
+    def remove_replica(self, rid: int) -> "ReplicaState":
+        """Drop replica ``rid`` from the registry (retire its batcher worker
+        FIRST — ``DynamicBatcher.retire_worker`` — so no dispatch targets
+        it). The last replica cannot be removed: a pool always serves."""
+        with self._lock:
+            keep = [r for r in self.replicas if r.rid != rid]
+            if len(keep) == len(self.replicas):
+                raise KeyError(f"no replica {rid} in the pool")
+            if not keep:
+                raise ValueError("cannot remove the last replica")
+            removed = next(r for r in self.replicas if r.rid == rid)
+            self.replicas = keep
+        return removed
+
+    @property
+    def weights_generation(self) -> int:
+        """The pool's SERVING generation: the minimum across replicas, so it
+        only advances once every replica has committed the new weights —
+        the number /healthz and the staleness gauge report."""
+        return min(
+            int(getattr(r.engine, "generation", 0)) for r in self.replicas
+        )
 
     # -- single-engine-compatible surface ----------------------------------
     @property

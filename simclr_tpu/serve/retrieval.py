@@ -72,6 +72,7 @@ class NeighborIndex:
         mesh=None,
         sentry=None,
         metrics=None,
+        generation: int = 0,
     ):
         if metric not in METRICS:
             raise ValueError(f"neighbors metric must be one of {METRICS}, got {metric!r}")
@@ -79,6 +80,11 @@ class NeighborIndex:
         if host.ndim != 2 or host.shape[0] < 1:
             raise ValueError(f"corpus must be (n >= 1, d), got {host.shape}")
         self.metric = metric
+        # which encoder generation embedded this corpus (coscheduler swap
+        # tag): a fresh index is built per weight swap and the server's
+        # index reference swapped atomically, so /v1/neighbors always
+        # answers from the same generation /v1/embed computes with
+        self.generation = int(generation)
         self.n, self.d = host.shape
         if metric == "cosine":
             host = _normalize_rows(host)
@@ -225,6 +231,7 @@ class NeighborIndex:
             "rows": self.n,
             "dim": self.d,
             "metric": self.metric,
+            "generation": self.generation,
             "shards": self.n_shards,
             "rows_per_shard": self.rows_per_shard,
             "corpus_hbm_bytes": int(self.corpus.nbytes),

@@ -93,6 +93,14 @@ class EmbedServer(ThreadingHTTPServer):
         self.recorder = recorder if recorder is not None else TraceRecorder()
         self.draining = threading.Event()
 
+    def swap_index(self, index) -> None:
+        """Atomically replace the retrieval index (generation-tagged corpus
+        swap). Handlers read ``self.server.index`` exactly once per request,
+        so an in-flight ``/v1/neighbors`` finishes on the index it started
+        with and the next request sees the new generation — the corpus
+        counterpart of ``EmbedEngine.commit``."""
+        self.index = index
+
 
 class EmbedHandler(BaseHTTPRequestHandler):
     server: EmbedServer
@@ -151,8 +159,17 @@ class EmbedHandler(BaseHTTPRequestHandler):
                 }
                 if self.server.pool is not None:
                     payload["replicas"] = self.server.pool.state()
-                if self.server.index is not None:
-                    payload["neighbors"] = self.server.index.hbm_state()
+                    # serving generation = min across replicas: advances
+                    # only once EVERY replica committed the new weights
+                    payload["weights_generation"] = (
+                        self.server.pool.weights_generation
+                    )
+                index = self.server.index
+                if index is not None:
+                    payload["neighbors"] = index.hbm_state()
+                    payload["corpus_generation"] = int(
+                        getattr(index, "generation", 0)
+                    )
                 self._send_json(200, payload)
         elif self.path == "/metrics":
             self._send(
@@ -234,6 +251,12 @@ class EmbedHandler(BaseHTTPRequestHandler):
         headers = (
             [("X-Served-By", str(served_by))] if served_by is not None else []
         )
+        # the weight generation the dispatching replica served this request
+        # with — what the co-scheduler smoke compares against the corpus
+        # generation for embed/neighbors consistency
+        generation = getattr(future, "generation", None)
+        if generation is not None:
+            headers.append(("X-Weights-Generation", str(generation)))
         self._send(200, body, "application/json", headers)
 
     def _post_neighbors(self, rid) -> None:
@@ -272,6 +295,7 @@ class EmbedHandler(BaseHTTPRequestHandler):
                 "k": k,
                 "metric": index.metric,
             },
+            [("X-Corpus-Generation", str(getattr(index, "generation", 0)))],
         )
 
     def _parse_neighbors(self, index) -> tuple:

@@ -45,6 +45,7 @@ poisoned / last child code when the budget runs out).
 from __future__ import annotations
 
 import json
+import math
 import os
 import signal
 import socket
@@ -114,6 +115,7 @@ class _Host:
     def __init__(self, slot: int):
         self.slot = slot
         self.lost = False
+        self.reallocated = False
         self.failures = 0
         self.cooldown_until = 0.0
         self.loss_reasons: list[str] = []
@@ -127,6 +129,21 @@ class _Host:
             backoff_delay(knobs, self.failures - 1),
         )
         self.cooldown_until = now + min(cooldown, knobs.backoff_max_s)
+
+    def mark_reallocated(self) -> None:
+        """Lend this host to the serve tier: lost as far as the training
+        mesh is concerned, but on an INFINITE cooldown — not a failure (no
+        backoff ledger entry), and never readmittable until ``release``."""
+        self.lost = True
+        self.reallocated = True
+        self.cooldown_until = math.inf
+
+    def release(self, now: float) -> None:
+        """Hand the host back to training: immediately readmittable, so the
+        existing grow-back trigger fires on the next supervisor poll."""
+        if self.reallocated:
+            self.reallocated = False
+            self.cooldown_until = now
 
     def readmittable(self, now: float) -> bool:
         return self.lost and now >= self.cooldown_until
@@ -182,8 +199,10 @@ class ElasticSupervisor:
         self.hosts = [_Host(i) for i in range(self.nprocs)]
         self.remesh_count = 0
         self.grow_back_count = 0
+        self.reallocate_count = 0
         self.hosts_timeline: list[int] = []
         self._stop: dict[str, int | None] = {"sig": None}
+        self._realloc: dict[str, bool] = {"shrink": False}
         self._children: list[subprocess.Popen] = []
         # validate the FULL topology up front: a bad global batch must fail
         # before any child is spawned, not at the first remesh
@@ -240,6 +259,45 @@ class ElasticSupervisor:
                 except OSError:
                     pass
 
+    # -- elastic reallocation (coscheduler) ---------------------------------
+    @property
+    def active_host_count(self) -> int:
+        return sum(1 for h in self.hosts if not h.lost)
+
+    @property
+    def reallocated_hosts(self) -> list[int]:
+        return [h.slot for h in self.hosts if h.reallocated]
+
+    def request_shrink(self) -> bool:
+        """Ask the main loop to drain ONE host out of the training mesh and
+        lend its devices to the serve tier. Serviced at the next supervisor
+        poll: the group drains via SIGTERM (guards checkpoint at the epoch
+        boundary and exit 75) and relaunches on the survivors through the
+        ordinary remesh path. Returns False — request dropped — when the
+        mesh is already at one host (a run always trains). Thread-safe:
+        called from the coscheduler's pressure-policy thread.
+        """
+        if self.active_host_count <= 1:
+            return False
+        self._realloc["shrink"] = True
+        return True
+
+    def release_reallocation(self) -> int:
+        """Hand every lent host back to training. The hosts become
+        readmittable immediately, so the existing grow-back trigger drains
+        the running group and remeshes back up at its next poll. Returns
+        the number of hosts released."""
+        now = time.monotonic()
+        released = [h for h in self.hosts if h.reallocated]
+        for h in released:
+            h.release(now)
+        if released:
+            self.events.emit(
+                "reallocate", direction="release",
+                hosts=[h.slot for h in released],
+            )
+        return len(released)
+
     def _on_stop(self, signum, frame) -> None:
         escalate = self._stop["sig"] is not None
         self._stop["sig"] = signum
@@ -284,6 +342,7 @@ class ElasticSupervisor:
                 "resumed": max(generation - 1, 0),
                 "remesh_count": self.remesh_count,
                 "grow_back_count": self.grow_back_count,
+                "reallocate_count": self.reallocate_count,
                 "hosts_timeline": list(self.hosts_timeline),
                 "hosts": "→".join(str(n) for n in self.hosts_timeline),
                 "host_table": {
@@ -291,6 +350,7 @@ class ElasticSupervisor:
                         "losses": h.failures,
                         "reasons": list(h.loss_reasons),
                         "lost": h.lost,
+                        "reallocated": h.reallocated,
                     }
                     for h in self.hosts
                 },
@@ -362,6 +422,7 @@ class ElasticSupervisor:
                     for rank in range(len(active))
                 }
                 drain_for_grow_back = False
+                drain_for_realloc = False
                 drain_deadline = None
                 lost: tuple[_Host, str, int | None] | None = None
 
@@ -389,7 +450,7 @@ class ElasticSupervisor:
                     finished = {
                         r: rc for r, rc in exits.items() if rc is not None
                     }
-                    if finished and not drain_for_grow_back:
+                    if finished and not (drain_for_grow_back or drain_for_realloc):
                         rank, rc = next(iter(finished.items()))
                         if len(finished) > 1:
                             # the faulted host's peers crash moments later
@@ -421,13 +482,15 @@ class ElasticSupervisor:
                         )
                         lost = (active[rank], reason, rc)
                         break
-                    if drain_for_grow_back and now > (drain_deadline or 0):
+                    if (drain_for_grow_back or drain_for_realloc) and now > (
+                        drain_deadline or 0
+                    ):
                         # drain overran the deadline (a child stuck before
                         # its next boundary): force it — the relaunch resumes
                         # from the previous checkpoint either way
                         self._kill_group()
                         break
-                    if not drain_for_grow_back:
+                    if not (drain_for_grow_back or drain_for_realloc):
                         hung = [
                             rank
                             for rank, tracker in trackers.items()
@@ -438,7 +501,35 @@ class ElasticSupervisor:
                             lost = (active[culprit], "wedged", None)
                             break
                     if (
-                        not drain_for_grow_back
+                        not (drain_for_grow_back or drain_for_realloc)
+                        and self._realloc["shrink"]
+                        and any(
+                            t.last_change is not None
+                            for t in trackers.values()
+                        )
+                    ):
+                        # coscheduler asked for a host: drain the group at
+                        # the next epoch boundary and relaunch one smaller.
+                        # Deliberately the same remesh-on-loss machinery a
+                        # real host loss takes — except the victim is parked
+                        # (infinite cooldown), not penalized (no failure
+                        # ledger entry, no restart-budget burn).
+                        self._realloc["shrink"] = False
+                        if len(active) > 1:
+                            victim = active[-1]
+                            victim.mark_reallocated()
+                            drain_for_realloc = True
+                            drain_deadline = now + self.knobs.startup_grace_s
+                            self.reallocate_count += 1
+                            self.events.emit(
+                                "reallocate", direction="shrink",
+                                attempt=generation, host=victim.slot,
+                                hosts_before=len(active),
+                                hosts_after=len(active) - 1,
+                            )
+                            self._signal_group(signal.SIGTERM)
+                    if (
+                        not (drain_for_grow_back or drain_for_realloc)
                         and len(active) < self.nprocs
                         and any(
                             h.readmittable(now) for h in self.hosts if h.lost
@@ -507,9 +598,9 @@ class ElasticSupervisor:
                 last_rc = exits[0] if exits else None
                 if all(rc == 0 for rc in exits):
                     return summary(OUTCOME_CLEAN, 0)
-                if drain_for_grow_back:
-                    # drained (75s, or forced): relaunch at the grown
-                    # topology next iteration
+                if drain_for_grow_back or drain_for_realloc:
+                    # drained (75s, or forced): relaunch at the grown (or
+                    # reallocation-shrunken) topology next iteration
                     continue
                 if all(rc == EXIT_PREEMPTED for rc in exits):
                     # the whole group drained without a stop from us or a

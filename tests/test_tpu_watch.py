@@ -21,9 +21,9 @@ WATCH = os.path.join(REPO, "scripts", "tpu_watch.sh")
 STAGES = (
     "loss_variants", "attrib512", "train_smoke", "bench",
     "allreduce_bench", "overlap_async", "augment_bench", "multihost_dryrun",
-    "elastic_dryrun", "fleet_smoke", "remat2048", "explore1024", "explore512",
-    "supervisor_smoke", "obs_smoke", "compile_audit", "superepoch",
-    "serve_scale", "run_report",
+    "elastic_dryrun", "fleet_smoke", "cosched_smoke", "remat2048",
+    "explore1024", "explore512", "supervisor_smoke", "obs_smoke",
+    "compile_audit", "superepoch", "serve_scale", "run_report",
 )
 
 
@@ -129,6 +129,17 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         'echo \'simclr_fleet_imgs_per_sec{host="0"} 100.0\'; '
         'echo \'simclr_fleet_imgs_per_sec{host="1"} 80.0\'; '
         "echo 'simclr_fleet_step_time_skew_ratio 1.3';; esac",
+        # the cosched_smoke stage greps its stdout for an error-free
+        # payload proving >= 2 hot-reload swaps, >= 1 elastic reallocation,
+        # and the embed/neighbors generation-consistency probe (the
+        # orchestrator also exits 0 on error); the pattern anchors on the
+        # argv END (the stage passes no flags)
+        'case "$*" in *cosched_smoke.py) '
+        'echo \'{"metric": "cosched_smoke", "value": 1.0, "unit": "bool", '
+        '"outcome": "clean", "swaps": 3, "swap_rejected": 0, '
+        '"reallocations": 1, "releases": 1, "grow_back_count": 1, '
+        '"serving_generation": 3, "generation_consistent": true, '
+        '"parity": true, "max_loss_delta": 0.009}\';; esac',
         # the supervisor_smoke stage greps its stdout for a clean outcome
         # with at least one resume (an uncrashed run also exits 0)
         'case "$*" in *simclr_tpu.supervisor*) '
@@ -462,6 +473,55 @@ def test_fleet_marker_requires_both_hosts_and_skew_gauge(tmp_path):
     r, state, log = _run_oneshot(tmp_path)
     assert "fleet_smoke" not in _done(state)
     assert (state / "fleet_smoke.fails").exists()
+
+
+def test_cosched_marker_requires_swaps_reallocation_and_consistency(tmp_path):
+    """The co-scheduler orchestrator exits 0 even on failure, so the done
+    marker must demand the full claim: at least TWO hot-reload generation
+    swaps AND at least one elastic reallocation AND the embed/neighbors
+    generation-consistency probe. A run that only ever served its first
+    checkpoint (swaps 1) proves nothing about CONTINUOUS reload."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace(
+        '"swaps": 3, "swap_rejected": 0',
+        '"swaps": 1, "swap_rejected": 0'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "cosched_smoke" not in _done(state)
+    assert (state / "cosched_smoke.fails").exists()
+    assert "stage cosched_smoke FAILED" in log.read_text()
+    # the dryruns sharing the window must be untouched
+    assert "multihost_dryrun" in _done(state)
+    assert "elastic_dryrun" in _done(state)
+
+    # second contract: swaps landed but the pressure burst never lent a
+    # host (reallocations 0) — the elastic half of the claim is unproven
+    stub.write_text(stub.read_text()
+                    .replace('"swaps": 1, "swap_rejected": 0',
+                             '"swaps": 3, "swap_rejected": 0')
+                    .replace('"reallocations": 1, "releases": 1',
+                             '"reallocations": 0, "releases": 0'))
+    (state / "cosched_smoke.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "cosched_smoke" not in _done(state)
+    assert (state / "cosched_smoke.fails").exists()
+
+    # third contract: a probe that caught /v1/neighbors answering on a
+    # STALE corpus generation is a torn-serve bug, not flakiness — and the
+    # last-ditch error payload also exits 0
+    stub.write_text(stub.read_text()
+                    .replace('"reallocations": 0, "releases": 0',
+                             '"reallocations": 1, "releases": 1')
+                    .replace('"generation_consistent": true',
+                             '"generation_consistent": false')
+                    .replace('"parity": true, "max_loss_delta": 0.009',
+                             '"parity": true, "max_loss_delta": 0.009, '
+                             '"error": "embed generation 3 != corpus '
+                             'generation 2"'))
+    (state / "cosched_smoke.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "cosched_smoke" not in _done(state)
+    assert (state / "cosched_smoke.fails").exists()
 
 
 def test_supervisor_marker_requires_an_actual_resume(tmp_path):

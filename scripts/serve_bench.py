@@ -41,6 +41,19 @@ Env knobs: ``SERVE_BENCH_URL``, ``SERVE_BENCH_CONCURRENCY`` (default
 ``SERVE_BENCH_TINY`` (self-host with the test suite's tiny model instead of
 resnet18), ``SERVE_BENCH_SYNTH_MS`` (self-host synthetic per-row engine),
 ``SERVE_BENCH_WEIGHTS`` (self-host weight storage: exact|bf16|int8).
+
+Retrieval mode (``SERVE_BENCH_CORPUS_ROWS`` set, self-host only): instead
+of the embed sweep, drive ``POST /v1/neighbors`` against one server whose
+:class:`NeighborIndex` is rebuilt and atomically swapped per (corpus size x
+dtype x exact/ivf) cell over a synthetic CLUSTERED corpus, reporting
+per-cell p50/p99 QPS **and recall@10 vs a numpy float64 oracle** plus the
+IVF-over-exact throughput speedup. Headline metric:
+``retrieval_requests_per_sec``. Extra knobs: ``SERVE_BENCH_CORPUS_ROWS``
+(comma list of corpus sizes), ``SERVE_BENCH_CORPUS_DIM`` (default 128),
+``SERVE_BENCH_DTYPES`` (default ``fp32,int8``), ``SERVE_BENCH_ANN_CELLS``
+(default 1024), ``SERVE_BENCH_ANN_PROBE`` (default 4),
+``SERVE_BENCH_QUERIES`` (query rows per request, default 64). The same
+emit-once / deadline / SIGTERM contract applies.
 """
 
 from __future__ import annotations
@@ -118,14 +131,22 @@ def make_body(rows: int) -> bytes:
 
 
 def run_level(
-    host: str, port: int, concurrency: int, rows: int, duration_s: float
+    host: str,
+    port: int,
+    concurrency: int,
+    rows: int,
+    duration_s: float,
+    *,
+    path: str = "/v1/embed",
+    body: bytes | None = None,
 ) -> dict:
     """One sweep level: ``concurrency`` closed-loop clients for ``duration_s``.
 
     Each client reuses one keep-alive connection and fires requests
     back-to-back; 429s are counted and retried after a short backoff (they
-    are the server doing its job, not a failure)."""
-    body = make_body(rows)
+    are the server doing its job, not a failure). ``path``/``body`` default
+    to the embed endpoint; retrieval mode points them at /v1/neighbors."""
+    body = body if body is not None else make_body(rows)
     latencies: list[float] = []
     counters = {"ok": 0, "rejected": 0, "errors": 0}
     lock = threading.Lock()
@@ -140,7 +161,7 @@ def run_level(
                 t0 = time.perf_counter()
                 try:
                     conn.request(
-                        "POST", "/v1/embed", body,
+                        "POST", path, body,
                         {"Content-Type": "application/json"},
                     )
                     r = conn.getresponse()
@@ -338,6 +359,196 @@ def self_hosted_server(max_batch: int, replicas: int = 1):
     return server, batcher, thread, extra, metrics
 
 
+def _clustered_corpus(n_rows: int, dim: int, seed: int = 0):
+    """Synthetic clustered corpus + queries + float64 oracle top-10.
+
+    Rows are unit-norm cluster centers plus Gaussian noise — realistic for
+    IVF (recall depends on cluster structure; iid-uniform rows would make
+    ANN look artificially bad) — and queries are perturbed corpus rows, the
+    retrieval workload's shape. Continuous floats: no score ties, so
+    recall-vs-oracle is well-defined.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_centers = 512
+    centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    corpus = (
+        centers[rng.integers(0, n_centers, n_rows)]
+        + 0.12 * rng.standard_normal((n_rows, dim)).astype(np.float32)
+    )
+    queries = (
+        corpus[rng.integers(0, n_rows, 256)]
+        + 0.05 * rng.standard_normal((256, dim)).astype(np.float32)
+    )
+    scores = queries.astype(np.float64) @ corpus.T.astype(np.float64)
+    oracle = np.argpartition(-scores, 10, axis=1)[:, :10]
+    return corpus, queries, oracle
+
+
+def _measured_recall(index, queries, oracle, k: int = 10) -> float:
+    """Mean recall@k of ``index`` against the oracle's true top-k sets."""
+    hits, total = 0, 0
+    step = index.max_queries
+    for i in range(0, queries.shape[0], step):
+        _, idx = index.query(queries[i : i + step], k)
+        for row, truth in zip(idx, oracle[i : i + step]):
+            hits += len(set(int(v) for v in row) & set(int(v) for v in truth))
+            total += k
+    return hits / total if total else 0.0
+
+
+def _retrieval_main(deadline: float) -> None:
+    """Corpus-size x (dtype, scan) sweep over /v1/neighbors (module docstring)."""
+    global _BEST_SO_FAR
+    import numpy as np
+
+    from simclr_tpu.config import load_config
+    from simclr_tpu.serve.metrics import ServeMetrics
+    from simclr_tpu.serve.replica import ReplicaPool
+    from simclr_tpu.serve.retrieval import NeighborIndex
+    from simclr_tpu.serve.server import shutdown_gracefully, start_server
+
+    rows_list = [
+        int(r)
+        for r in os.environ["SERVE_BENCH_CORPUS_ROWS"].split(",")
+        if r.strip()
+    ]
+    dim = int(os.environ.get("SERVE_BENCH_CORPUS_DIM", 128))
+    dtypes = [
+        s.strip()
+        for s in os.environ.get("SERVE_BENCH_DTYPES", "fp32,int8").split(",")
+        if s.strip()
+    ]
+    ann_cells = int(os.environ.get("SERVE_BENCH_ANN_CELLS", 1024))
+    ann_probe = int(os.environ.get("SERVE_BENCH_ANN_PROBE", 4))
+    qbatch = int(os.environ.get("SERVE_BENCH_QUERIES", 64))
+    k = 10
+    duration_s = float(os.environ.get("SERVE_BENCH_DURATION_S", DEFAULT_DURATION_S))
+    concurrency_levels = [
+        int(c)
+        for c in os.environ.get("SERVE_BENCH_CONCURRENCY", DEFAULT_CONCURRENCY).split(",")
+        if c.strip()
+    ]
+
+    cells: dict[str, dict] = {}
+    recalls: dict[str, float] = {}
+    skipped: list[str] = []
+    extra = {
+        "self_hosted": True,
+        "mode": "retrieval",
+        "corpus_dim": dim,
+        "queries_per_request": qbatch,
+        "k": k,
+        "ann_cells": ann_cells,
+        "ann_probe": ann_probe,
+    }
+
+    metrics = ServeMetrics()
+    cfg = load_config(
+        "serve",
+        overrides=[
+            "serve.port=0",
+            f"serve.max_batch={qbatch}",
+            "experiment.target_dir=unused-self-hosted",
+        ],
+    )
+    # /v1/neighbors never touches the engine; a synthetic pool keeps the
+    # server honest (batcher, drain, metrics) without model weights
+    pool = ReplicaPool([_SyntheticEngine(0, qbatch, 0.01)])
+    server, _batcher = start_server(cfg, pool=pool, metrics=metrics)
+    thread = threading.Thread(
+        target=server.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
+    )
+    thread.start()
+    host, port = server.server_address[:2]
+
+    def payload_now() -> dict:
+        best_name, best_rps = None, 0.0
+        for name, lv in cells.items():
+            r = max((l["requests_per_sec"] for l in lv.values()), default=0.0)
+            if r >= best_rps:
+                best_name, best_rps = name, r
+        payload = {
+            "metric": "retrieval_requests_per_sec",
+            "value": best_rps,
+            "unit": "req/s",
+            "best_cell": best_name,
+            "recall_at_10": dict(recalls),
+            "cells": cells,
+            "recompile_alarms": int(metrics.recompile_alarms_total.value),
+            **extra,
+        }
+        speedups = {}
+        for n_rows in rows_list:
+            exact = cells.get(f"n{n_rows}-fp32-exact")
+            ivf = cells.get(f"n{n_rows}-fp32-ivf")
+            if exact and ivf:
+                er = max((l["requests_per_sec"] for l in exact.values()), default=0.0)
+                ir = max((l["requests_per_sec"] for l in ivf.values()), default=0.0)
+                if er > 0:
+                    speedups[str(n_rows)] = round(ir / er, 2)
+        if speedups:
+            payload["ivf_speedup"] = speedups
+        if skipped:
+            payload["skipped_cells"] = skipped
+        return payload
+
+    try:
+        for n_rows in rows_list:
+            corpus, queries, oracle = _clustered_corpus(n_rows, dim)
+            for dtype in dtypes:
+                for scan in ("exact", "ivf"):
+                    name = f"n{n_rows}-{dtype}-{scan}"
+                    # budget discipline: a cell that cannot build + run one
+                    # level inside the budget is dropped LOUDLY
+                    if deadline - time.monotonic() - EMIT_RESERVE_S < 2.0:
+                        skipped.append(name)
+                        print(f"# budget exhausted; skipped cell {name}",
+                              file=sys.stderr)
+                        continue
+                    index = NeighborIndex(
+                        corpus,
+                        max_queries=qbatch,
+                        metrics=metrics,
+                        corpus_dtype=dtype,
+                        ann_cells=ann_cells if scan == "ivf" else 0,
+                        ann_probe=ann_probe,
+                    )
+                    index.query(queries[:qbatch], k)  # warm the served bucket
+                    recalls[name] = round(
+                        _measured_recall(index, queries, oracle, k), 4
+                    )
+                    server.swap_index(index)
+                    body = json.dumps(
+                        {"queries": queries[:qbatch].tolist(), "k": k}
+                    ).encode()
+                    levels: list[dict] = []
+                    for c in concurrency_levels:
+                        budget_left = deadline - time.monotonic() - EMIT_RESERVE_S
+                        if budget_left < 1.0:
+                            skipped.append(f"{name}@c{c}")
+                            print(f"# budget exhausted; skipped {name} "
+                                  f"concurrency={c}", file=sys.stderr)
+                            continue
+                        level = run_level(
+                            host, port, c, qbatch,
+                            min(duration_s, budget_left),
+                            path="/v1/neighbors", body=body,
+                        )
+                        level["recall_at_10"] = recalls[name]
+                        levels.append(level)
+                        print(f"# {name} level {level}", file=sys.stderr)
+                        cells[name] = {str(l["concurrency"]): l for l in levels}
+                        _BEST_SO_FAR = payload_now()
+    finally:
+        shutdown_gracefully(server, drain_timeout_s=10)
+        thread.join(timeout=10)
+        server.server_close()
+    _emit_payload(payload_now())
+
+
 def main() -> None:
     global _BEST_SO_FAR
     deadline = time.monotonic() + float(
@@ -355,6 +566,10 @@ def main() -> None:
         for c in os.environ.get("SERVE_BENCH_CONCURRENCY", DEFAULT_CONCURRENCY).split(",")
         if c.strip()
     ]
+
+    if os.environ.get("SERVE_BENCH_CORPUS_ROWS"):
+        _retrieval_main(deadline)
+        return
 
     url = os.environ.get("SERVE_BENCH_URL")
     if url:

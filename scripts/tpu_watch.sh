@@ -54,7 +54,7 @@ MAX_STAGE_FAILS=3
 # chip lock — proves the pod code path on the host), then the remaining
 # step matrices, and last the supervisor kill/resume smoke (fault
 # tolerance proven on the real chip, docs/FAULT_TOLERANCE.md).
-STAGES="loss_variants attrib512 train_smoke bench allreduce_bench overlap_async augment_bench multihost_dryrun elastic_dryrun fleet_smoke cosched_smoke remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale run_report"
+STAGES="loss_variants attrib512 train_smoke bench allreduce_bench overlap_async augment_bench multihost_dryrun elastic_dryrun fleet_smoke cosched_smoke remat2048 explore1024 explore512 supervisor_smoke obs_smoke compile_audit superepoch serve_scale retrieval_bench run_report"
 CAPTURE="${BENCH_CAPTURE_PATH:-BENCH_TPU_CAPTURE.json}"
 
 case "${JAX_PLATFORMS:-}" in
@@ -435,6 +435,33 @@ run_stage() {
                 grep -q '"metric": "serve_requests_per_sec"' "$out" \
                     && grep -Eq '"scaling": \{"replicas": [2-9]' "$out" \
                     && grep -q '"p99_ms"' "$out" \
+                    && grep -Eq '"recompile_alarms": 0[,}]' "$out" \
+                    && ! grep -q '"error"' "$out"
+                rc=$?
+            fi ;;
+        retrieval_bench)
+            # production-scale retrieval evidence (scripts/serve_bench.py
+            # in retrieval mode, selected by SERVE_BENCH_CORPUS_ROWS): a
+            # 100k-row synthetic clustered corpus swept over
+            # (fp32|int8) x (exact|IVF) through the live /v1/neighbors
+            # stack. Unlike serve_scale this builds REAL device-resident
+            # corpus shards (quantized buckets, IVF tiles), so it takes
+            # the chip lock. The bench exits 0 even on error, so the done
+            # marker requires the retrieval metric with a recall column
+            # (every cell reports recall@10 next to its throughput), zero
+            # recompile alarms, and no error field.
+            out="$STATE/retrieval_bench.out"
+            run_locked "$(stage_timeout 1200)" env \
+                SERVE_BENCH_CORPUS_ROWS=100000 \
+                SERVE_BENCH_DTYPES=fp32,int8 \
+                SERVE_BENCH_CONCURRENCY=2,8 SERVE_BENCH_DURATION_S=3 \
+                SERVE_BENCH_BUDGET_S=600 \
+                python scripts/serve_bench.py > "$out" 2>&1
+            rc=$?
+            cat "$out" >> "$LOG"
+            if [ "$rc" -eq 0 ]; then
+                grep -q '"metric": "retrieval_requests_per_sec"' "$out" \
+                    && grep -q '"recall_at_10"' "$out" \
                     && grep -Eq '"recompile_alarms": 0[,}]' "$out" \
                     && ! grep -q '"error"' "$out"
                 rc=$?

@@ -767,6 +767,32 @@ def check_serve_conf(
         metric in ("dot", "cosine"),
         f"serve.neighbors_metric must be dot|cosine, got {metric!r}",
     )
+    # mirrors parallel.compress.CORPUS_DTYPE_MODES (jax-free module, same
+    # reason serve.weights inlines its set)
+    corpus_dtype = cfg.select("serve.corpus_dtype", "fp32")
+    _require(
+        corpus_dtype in ("fp32", "int8"),
+        f"serve.corpus_dtype must be fp32|int8, got {corpus_dtype!r}",
+    )
+    ann_cells = cfg.select("serve.ann_cells", 0)
+    _require(
+        isinstance(ann_cells, int) and not isinstance(ann_cells, bool)
+        and 0 <= ann_cells <= 65536,
+        "serve.ann_cells must be an int in [0, 65536] (IVF cells per shard; "
+        f"0 = exact scan), got {ann_cells!r}",
+    )
+    ann_probe = cfg.select("serve.ann_probe", 1)
+    _require(
+        isinstance(ann_probe, int) and not isinstance(ann_probe, bool)
+        and ann_probe >= 1,
+        f"serve.ann_probe must be an int >= 1 (cells scored per query), "
+        f"got {ann_probe!r}",
+    )
+    _require(
+        ann_cells == 0 or ann_probe <= ann_cells,
+        f"serve.ann_probe must be <= serve.ann_cells ({ann_cells}) when the "
+        f"IVF scan is on, got {ann_probe!r}",
+    )
     # one of the checkpoint sources must be real — except under the
     # co-scheduler, which serves random generation-0 weights and hot-reloads
     # checkpoints as training writes them (check_cosched_conf)

@@ -156,6 +156,9 @@ class CoScheduler:
             corpus_images=corpus_images,
             reembed_batch=int(cfg.select("cosched.reembed_batch", 256)),
             neighbors_metric=str(cfg.select("serve.neighbors_metric", "dot")),
+            corpus_dtype=str(cfg.select("serve.corpus_dtype", "fp32")),
+            ann_cells=int(cfg.select("serve.ann_cells", 0) or 0),
+            ann_probe=int(cfg.select("serve.ann_probe", 1) or 1),
             poll_s=float(cfg.select("cosched.reload_poll_s", 2.0)),
         )
         self.reload.current_variables = variables
@@ -372,6 +375,12 @@ class CoScheduler:
             "reallocations": self.supervisor.reallocate_count,
             "serving_generation": self.pool.weights_generation,
             "serve_replicas": self.pool.size,
+            "corpus_generation": getattr(
+                getattr(self.server, "corpus_store", None), "generation", None
+            ),
+            "corpus_rows": getattr(
+                getattr(self.server, "corpus_store", None), "rows", None
+            ),
             "train": train_result,
         }
         atomic_write(

@@ -80,6 +80,9 @@ class ReloadManager:
         corpus_images: np.ndarray | None = None,
         reembed_batch: int = 256,
         neighbors_metric: str = "dot",
+        corpus_dtype: str = "fp32",
+        ann_cells: int = 0,
+        ann_probe: int = 1,
         poll_s: float = 2.0,
         load_fn=None,
     ):
@@ -91,6 +94,9 @@ class ReloadManager:
         self.corpus_images = corpus_images
         self.reembed_batch = int(reembed_batch)
         self.neighbors_metric = neighbors_metric
+        self.corpus_dtype = str(corpus_dtype)
+        self.ann_cells = int(ann_cells)
+        self.ann_probe = int(ann_probe)
         self.poll_s = float(poll_s)
         self._load = load_fn if load_fn is not None else _default_load
         # serialized swap/attach state: the policy thread resyncs freshly
@@ -130,24 +136,51 @@ class ReloadManager:
             ]
         )
 
+    def _index_kwargs(self) -> dict:
+        return {
+            "metric": self.neighbors_metric,
+            "corpus_dtype": self.corpus_dtype,
+            "ann_cells": self.ann_cells,
+            "ann_probe": self.ann_probe,
+            "max_queries": self.pool.primary.max_batch,
+            "sentry": self.pool.primary.sentry,
+        }
+
     def _build_index(self, embeddings: np.ndarray, generation: int):
         from simclr_tpu.serve.retrieval import NeighborIndex
 
         return NeighborIndex(
             embeddings,
-            metric=self.neighbors_metric,
-            max_queries=self.pool.primary.max_batch,
-            sentry=self.pool.primary.sentry,
             metrics=self.metrics,
             generation=generation,
+            **self._index_kwargs(),
         )
 
     def publish_index(self, embeddings: np.ndarray, generation: int) -> None:
         """Build + swap a generation-tagged index (also used by the core
-        for the generation-0 corpus before traffic starts)."""
+        for the generation-0 corpus before traffic starts).
+
+        Routes through the server's :class:`MutableCorpus` when one exists,
+        so a per-swap re-embed and live ``/v1/corpus/*`` mutations share one
+        generation sequence (the store keeps it monotone either way); the
+        first publish creates the store and attaches it to the server.
+        """
         if self.server is not None:
-            self.server.swap_index(self._build_index(embeddings, generation))
-        if self.metrics is not None:
+            from simclr_tpu.serve.retrieval import MutableCorpus
+
+            store = getattr(self.server, "corpus_store", None)
+            if store is None:
+                store = MutableCorpus(
+                    embeddings,
+                    server=self.server,
+                    metrics=self.metrics,
+                    generation=generation,
+                    **self._index_kwargs(),
+                )
+                self.server.corpus_store = store
+            else:
+                store.replace(embeddings, generation)
+        elif self.metrics is not None:
             self.metrics.corpus_generation.set(generation)
 
     def bootstrap_corpus(self) -> None:

@@ -149,6 +149,47 @@ def centroid_probe(
     }
 
 
+def kmeans(
+    features, num_clusters: int, *, iters: int = 8, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Lloyd's k-means over feature rows, built from the centroid probe's
+    primitives — the IVF coarse quantizer for the serve tier's ANN path
+    (``serve.ann_cells``, ``serve/retrieval.py``).
+
+    Assignment uses the same ``X @ W`` product as :func:`centroid_logits`
+    corrected to true nearest-centroid (``argmax(x·c − ½‖c‖²)``, equivalent
+    to min squared distance); the update is exactly
+    :func:`centroid_weights` — per-cluster means — with empty clusters
+    RETAINING their previous centroid (``centroid_weights`` clips empty
+    counts to 1 and yields zeros, which would teleport the centroid to the
+    origin and strand it). Init is a seeded permutation of distinct rows, so
+    the clustering — and therefore the serve tier's cell layout — is
+    deterministic per (corpus, seed). Returns ``(centroids (C, d) f32,
+    assignments (n,) int32)`` as host numpy.
+    """
+    X = jnp.asarray(np.asarray(features, np.float32))
+    n, _ = X.shape
+    c = max(1, min(int(num_clusters), n))
+    init = np.random.default_rng(seed).permutation(n)[:c]
+    weights = X[jnp.asarray(init)].T  # (d, C), the centroid_weights layout
+
+    @jax.jit
+    def step(w):
+        logits = centroid_logits(X, w) - 0.5 * jnp.sum(w * w, axis=0)
+        assign = jnp.argmax(logits, axis=1)
+        counts = jnp.sum(jax.nn.one_hot(assign, c, dtype=X.dtype), axis=0)
+        w2 = centroid_weights(X, assign, c)
+        return jnp.where(counts[None, :] > 0, w2, w), assign
+
+    assign = None
+    for _ in range(max(int(iters), 1)):
+        weights, assign = step(weights)
+    return (
+        np.asarray(weights.T, np.float32),
+        np.asarray(assign, np.int32),
+    )
+
+
 def make_local_centroid_monitor(
     model,
     *,

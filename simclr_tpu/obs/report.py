@@ -253,6 +253,8 @@ def build_report(
                 swap_events[-1].get("epoch") if swap_events else None
             ),
             "serve_replicas": (cosched or {}).get("serve_replicas"),
+            "corpus_generation": (cosched or {}).get("corpus_generation"),
+            "corpus_rows": (cosched or {}).get("corpus_rows"),
         }
 
     # fleet view: one row per heartbeat.p<i>.json (every host beats), the
@@ -443,11 +445,16 @@ def render_report(report: dict) -> str:
             f" replicas={serve['serve_replicas']}"
             if serve.get("serve_replicas") is not None else ""
         )
+        corpus_part = (
+            f" corpus=gen{serve['corpus_generation']}/"
+            f"{serve.get('corpus_rows')}rows"
+            if serve.get("corpus_generation") is not None else ""
+        )
         lines.append(
             f"serve: swaps={serve['swaps']}{reject_part} "
             f"generation={serve.get('serving_generation')} "
             f"reallocations={serve['reallocations']} "
-            f"(released {serve['releases']}){replica_part}"
+            f"(released {serve['releases']}){replica_part}{corpus_part}"
         )
         if serve.get("last_swap_epoch") is not None:
             lines.append(f"  last swap: epoch {serve['last_swap_epoch']}")

@@ -100,6 +100,13 @@ GRAD_ALLREDUCE_MODES = ("exact", "bf16", "int8")
 # rounding that makes the gradient estimator unbiased is exactly wrong here
 WEIGHT_QUANT_MODES = ("exact", "bf16", "int8")
 
+# storage formats for the serve tier's retrieval corpus (serve.corpus_dtype):
+# fp32 keeps the exact row-sharded matrix; int8 stores each shard's row block
+# in the same deterministic bucket format as WEIGHT_QUANT_MODES' int8 (one
+# fp32 scale per DEFAULT_BUCKET_SIZE elements, round-to-nearest) and
+# dequantizes INSIDE the jitted scoring kernel — ~3.98x more rows per device
+CORPUS_DTYPE_MODES = ("fp32", "int8")
+
 # overlap strategy for the gradient all-reduce: "off" is the single-shot
 # fused-collective path (bitwise-identical to PR 4), "chunked" decomposes it
 # into parallel.comm_chunks independent ppermute rings XLA can overlap, and
@@ -237,6 +244,43 @@ def weight_storage_bytes(
         return 2 * n
     n_buckets = -(-n // bucket_size) if n else 1
     return n_buckets * bucket_size + 4 * n_buckets
+
+
+def validate_corpus_dtype(mode: str) -> str:
+    """Reject unknown serve.corpus_dtype modes with the valid set spelled out."""
+    if mode not in CORPUS_DTYPE_MODES:
+        raise ValueError(
+            f"serve.corpus_dtype must be one of {CORPUS_DTYPE_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def corpus_storage_bytes(
+    n_rows: int,
+    dim: int,
+    mode: str,
+    *,
+    shards: int = 1,
+    bucket_size: int = DEFAULT_BUCKET_SIZE,
+) -> int:
+    """Analytic resident HBM bytes for a row-sharded retrieval corpus.
+
+    The corpus sibling of :func:`weight_storage_bytes`. Rows are ceil-split
+    over ``shards`` devices (each shard padded to the common per-shard row
+    count R = ceil(n_rows / shards)); fp32 costs 4·R·d per shard, int8 packs
+    each shard's (R·d,) block into whole buckets (1 B/elem) plus one fp32
+    scale per bucket — ``4 / (1 + 4/bucket_size)`` ≈ 3.98x under fp32 at the
+    default bucket size. ``hbm_state()`` reports the measured twin of this
+    number so the two can be reconciled in tests and the runbook.
+    """
+    validate_corpus_dtype(mode)
+    s = max(int(shards), 1)
+    rows_per_shard = -(-int(n_rows) // s) if n_rows else 0
+    elems = rows_per_shard * int(dim)
+    if mode == "fp32":
+        return 4 * elems * s
+    n_buckets = -(-elems // bucket_size) if elems else 1
+    return s * (n_buckets * bucket_size + 4 * n_buckets)
 
 
 def _chunk_bounds(n_elements: int, chunks: int) -> list[tuple[int, int]]:

@@ -76,6 +76,12 @@ class ServeMetrics:
         self.corpus_hbm_bytes = Gauge(
             "simclr_serve_corpus_hbm_bytes",
             "Row-sharded retrieval corpus bytes resident in device HBM")
+        self.corpus_rows = Gauge(
+            "simclr_serve_corpus_rows",
+            "Embedding rows in the resident retrieval corpus")
+        self.ann_cells_probed = Gauge(
+            "simclr_serve_ann_cells_probed",
+            "IVF cells scored per query per shard (0 = exact scan)")
         # continuous-reload plane (coscheduler): generation/staleness of the
         # weights the pool is serving, plus the swap outcome counters the
         # chaos tests pin (a rejected swap must bump swap_rejected_total and
@@ -126,6 +132,7 @@ class ServeMetrics:
                 self.client_disconnects_total,
                 self.neighbors_requests_total, self.neighbors_queries_total,
                 self.neighbors_latency_ms, self.corpus_hbm_bytes,
+                self.corpus_rows, self.ann_cells_probed,
                 self.weights_generation, self.corpus_generation,
                 self.checkpoint_staleness_seconds,
                 self.weight_swaps_total, self.swap_rejected_total,

@@ -16,6 +16,14 @@ Endpoints:
     ``serve/retrieval.py``); response ``{"indices": [[...]], "scores":
     [[...]]}`` row-aligned with the queries. 404 when no corpus is
     configured, 400 malformed queries/k, 503 draining.
+  * ``POST /v1/corpus/upsert`` — body ``{"ids": [int, ...], "embeddings":
+    [[d floats], ...]}``: insert-or-update corpus rows by external id.
+    ``POST /v1/corpus/delete`` — body ``{"ids": [int, ...]}``. Both commit
+    a fresh generation-tagged index with one atomic swap (zero downtime —
+    in-flight queries finish on the generation they started with) and
+    answer ``{"generation": g, "rows": n}`` + ``X-Corpus-Generation``.
+    404 when the corpus is not mutable (no store), 400 bad ids/shapes,
+    503 draining.
   * ``GET /healthz`` — 200 once warm and accepting (with per-replica
     state under ``"replicas"`` and corpus residency under ``"neighbors"``),
     503 while draining.
@@ -81,6 +89,7 @@ class EmbedServer(ThreadingHTTPServer):
         pool=None,
         index=None,
         neighbors_k_default=10,
+        corpus_store=None,
     ):
         super().__init__(address, EmbedHandler)
         self.engine = engine
@@ -88,6 +97,8 @@ class EmbedServer(ThreadingHTTPServer):
         self.metrics = metrics
         self.pool = pool          # serve/replica.py ReplicaPool (healthz fan-out)
         self.index = index        # serve/retrieval.py NeighborIndex, or None
+        # serve/retrieval.py MutableCorpus: enables /v1/corpus/* mutations
+        self.corpus_store = corpus_store
         self.neighbors_k_default = int(neighbors_k_default)
         self.request_timeout_s = float(request_timeout_s)
         self.recorder = recorder if recorder is not None else TraceRecorder()
@@ -190,6 +201,9 @@ class EmbedHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/neighbors":
             self._post_neighbors(rid)
             return
+        if self.path in ("/v1/corpus/upsert", "/v1/corpus/delete"):
+            self._post_corpus(rid, self.path.rsplit("/", 1)[1])
+            return
         if self.path != "/v1/embed":
             self._send_json(404, {"error": f"no such path {self.path!r}"})
             return
@@ -287,15 +301,74 @@ class EmbedHandler(BaseHTTPRequestHandler):
             logger.warning("neighbors %s failed: %r", rid, e)
             self._send_json(500, {"error": repr(e)})
             return
+        payload = {
+            "indices": indices.tolist(),
+            "scores": scores.tolist(),
+            "k": k,
+            "metric": index.metric,
+        }
+        row_ids = getattr(index, "row_ids", None)
+        if row_ids is not None:
+            # external ids for a mutable corpus; ANN padding slots (idx -1)
+            # stay -1
+            payload["ids"] = np.where(
+                indices >= 0,
+                row_ids[np.clip(indices, 0, len(row_ids) - 1)],
+                -1,
+            ).tolist()
         self._send_json(
             200,
-            {
-                "indices": indices.tolist(),
-                "scores": scores.tolist(),
-                "k": k,
-                "metric": index.metric,
-            },
+            payload,
             [("X-Corpus-Generation", str(getattr(index, "generation", 0)))],
+        )
+
+    def _post_corpus(self, rid, action: str) -> None:
+        store = self.server.corpus_store
+        if store is None:
+            self._send_json(
+                404,
+                {"error": "corpus is not mutable "
+                          "(serve without a corpus store; set serve.corpus)"},
+            )
+            return
+        if self.server.draining.is_set():
+            self._send_json(
+                503, {"error": "server is draining"}, [("Retry-After", "1")]
+            )
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            self._send_json(400, {"error": "missing request body"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except (ValueError, UnicodeDecodeError) as e:
+            self._send_json(400, {"error": f"body is not valid JSON: {e}"})
+            return
+        needed = ("ids", "embeddings") if action == "upsert" else ("ids",)
+        if not isinstance(payload, dict) or any(k not in payload for k in needed):
+            self._send_json(
+                400,
+                {"error": f'body must be a JSON object with {" and ".join(needed)}'},
+            )
+            return
+        try:
+            if action == "upsert":
+                out = store.upsert(payload["ids"], payload["embeddings"])
+            else:
+                out = store.delete(payload["ids"])
+        except (ValueError, TypeError) as e:
+            logger.debug("corpus %s %s rejected: %s", action, rid, e)
+            self._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:  # device failure mid-rebuild
+            logger.warning("corpus %s %s failed: %r", action, rid, e)
+            self._send_json(500, {"error": repr(e)})
+            return
+        out = dict(out)
+        out["status"] = "committed"
+        self._send_json(
+            200, out, [("X-Corpus-Generation", str(out["generation"]))]
         )
 
     def _parse_neighbors(self, index) -> tuple:
@@ -427,7 +500,9 @@ def run_server(cfg) -> int:
     return 0
 
 
-def start_server(cfg, *, engine=None, metrics=None, pool=None, index=None) -> tuple:
+def start_server(
+    cfg, *, engine=None, metrics=None, pool=None, index=None, corpus_store=None
+) -> tuple:
     """Construct (EmbedServer, DynamicBatcher) bound to ``serve.host:port``
     without entering the accept loop — the embeddable/testable core of
     :func:`run_server`. Caller runs ``serve_forever`` and later
@@ -437,7 +512,11 @@ def start_server(cfg, *, engine=None, metrics=None, pool=None, index=None) -> tu
     replicated path; a bare ``engine`` is wrapped into a pool of one, so
     every server runs the same per-replica worker machinery. ``index``
     (a :class:`~simclr_tpu.serve.retrieval.NeighborIndex`) enables
-    ``/v1/neighbors``; when None it is built from ``serve.corpus`` if set.
+    ``/v1/neighbors``; when None it is built from ``serve.corpus`` if set —
+    through a :class:`~simclr_tpu.serve.retrieval.MutableCorpus`, so a
+    file-configured corpus accepts ``/v1/corpus/*`` mutations out of the
+    box. An explicit ``corpus_store`` supplies both the index and the
+    mutation path.
     """
     from simclr_tpu.serve.batcher import DynamicBatcher
     from simclr_tpu.serve.metrics import ServeMetrics
@@ -458,23 +537,33 @@ def start_server(cfg, *, engine=None, metrics=None, pool=None, index=None) -> tu
         queue_depth=int(cfg.serve.queue_depth),
         metrics=metrics,
     )
+    if index is None and corpus_store is not None:
+        index = corpus_store.index
     if index is None:
         corpus = cfg.select("serve.corpus")
         if corpus:
-            from simclr_tpu.serve.retrieval import NeighborIndex
+            from simclr_tpu.serve.retrieval import MutableCorpus
 
-            index = NeighborIndex.from_file(
+            corpus_store = MutableCorpus.from_file(
                 str(corpus),
+                metrics=metrics,
                 metric=str(cfg.select("serve.neighbors_metric", "dot")),
                 max_queries=primary.max_batch,
                 sentry=primary.sentry,
-                metrics=metrics,
+                corpus_dtype=str(cfg.select("serve.corpus_dtype", "fp32")),
+                ann_cells=int(cfg.select("serve.ann_cells", 0) or 0),
+                ann_probe=int(cfg.select("serve.ann_probe", 1) or 1),
+            )
+            index = corpus_store.index
+            scan = (
+                f"ivf {index.ann_cells}x{index.cell_rows} probe {index.ann_probe}"
+                if index.ann_cells else "exact"
             )
             logger.info(
                 "retrieval corpus resident: %d rows x %d dims over %d shards "
-                "(%.1f MiB HBM)",
-                index.n, index.d, index.n_shards,
-                index.corpus.nbytes / 2**20,
+                "(%s, %s, %.1f MiB HBM)",
+                index.n, index.d, index.n_shards, index.dtype, scan,
+                index.hbm_state()["corpus_hbm_bytes"] / 2**20,
             )
     requests_log = cfg.select("serve.requests_log")
     recorder = TraceRecorder(
@@ -491,7 +580,11 @@ def start_server(cfg, *, engine=None, metrics=None, pool=None, index=None) -> tu
         pool=pool,
         index=index,
         neighbors_k_default=int(cfg.select("serve.neighbors_k", 10)),
+        corpus_store=corpus_store,
     )
+    if corpus_store is not None:
+        # mutations committed from here on swap this server's index
+        corpus_store.server = server
     return server, batcher
 
 

@@ -152,6 +152,52 @@ def test_serve_config_defaults_and_validation():
     check_serve_conf(cfg)
 
 
+def test_serve_retrieval_knob_validation():
+    cfg = load_config("serve")
+    cfg.serve.checkpoint = "/tmp/ckpts/epoch=1-m"
+    # defaults: exact fp32 scan
+    assert cfg.serve.corpus_dtype == "fp32"
+    assert cfg.serve.ann_cells == 0
+    assert cfg.serve.ann_probe == 1
+    check_serve_conf(cfg)
+
+    cfg.serve.corpus_dtype = "int8"
+    check_serve_conf(cfg)
+    cfg.serve.corpus_dtype = "fp16"
+    with pytest.raises(ConfigError, match="corpus_dtype must be fp32|int8"):
+        check_serve_conf(cfg)
+    cfg.serve.corpus_dtype = "fp32"
+
+    for bad_cells in (-1, 65537, 4.0, True):
+        cfg.serve.ann_cells = bad_cells
+        with pytest.raises(ConfigError, match="ann_cells"):
+            check_serve_conf(cfg)
+    cfg.serve.ann_cells = 65536
+    cfg.serve.ann_probe = 65536
+    check_serve_conf(cfg)
+
+    for bad_probe in (0, -3, 2.0, False):
+        cfg.serve.ann_probe = bad_probe
+        with pytest.raises(ConfigError, match="ann_probe"):
+            check_serve_conf(cfg)
+
+    # probe may not exceed the cell count when the IVF scan is on...
+    cfg.serve.ann_cells = 8
+    cfg.serve.ann_probe = 9
+    with pytest.raises(ConfigError, match="ann_probe must be <= serve.ann_cells"):
+        check_serve_conf(cfg)
+    # ...but any probe is fine on the exact path (cells == 0)
+    cfg.serve.ann_cells = 0
+    check_serve_conf(cfg)
+
+
+def test_cosched_serve_retrieval_knob_defaults():
+    cfg = load_config("cosched")
+    assert cfg.serve.corpus_dtype == "fp32"
+    assert cfg.serve.ann_cells == 0
+    assert cfg.serve.ann_probe == 1
+
+
 def test_bad_override_syntax_raises():
     with pytest.raises(ConfigError):
         load_config("config", ["parameter.epochs"])

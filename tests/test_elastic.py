@@ -403,7 +403,8 @@ class TestElasticSupervisor:
         assert summary["hosts_timeline"] == [2, 1, 2]
         assert summary["hosts"] == "2→1→2"
         assert summary["host_table"]["1"] == {
-            "losses": 1, "reasons": ["crashed"], "lost": False}
+            "losses": 1, "reasons": ["crashed"], "lost": False,
+            "reallocated": False}
         assert summary["host_table"]["0"]["losses"] == 0
         # grow-backs do not burn the restart budget
         assert summary["restarts"] == {"host_lost": 1, "grow_back": 1}

@@ -130,6 +130,27 @@ simclr_serve_neighbors_latency_ms_count 1
 # HELP simclr_serve_corpus_hbm_bytes Row-sharded retrieval corpus bytes resident in device HBM
 # TYPE simclr_serve_corpus_hbm_bytes gauge
 simclr_serve_corpus_hbm_bytes 0
+# HELP simclr_serve_corpus_rows Embedding rows in the resident retrieval corpus
+# TYPE simclr_serve_corpus_rows gauge
+simclr_serve_corpus_rows 0
+# HELP simclr_serve_ann_cells_probed IVF cells scored per query per shard (0 = exact scan)
+# TYPE simclr_serve_ann_cells_probed gauge
+simclr_serve_ann_cells_probed 0
+# HELP simclr_serve_weights_generation Checkpoint generation the replica pool is serving (0 = startup weights)
+# TYPE simclr_serve_weights_generation gauge
+simclr_serve_weights_generation 0
+# HELP simclr_serve_corpus_generation Encoder generation that embedded the resident retrieval corpus
+# TYPE simclr_serve_corpus_generation gauge
+simclr_serve_corpus_generation 0
+# HELP simclr_serve_checkpoint_staleness_seconds Seconds since the serving generation's checkpoint was written
+# TYPE simclr_serve_checkpoint_staleness_seconds gauge
+simclr_serve_checkpoint_staleness_seconds 0
+# HELP simclr_serve_weight_swaps_total Zero-downtime weight generation swaps committed to every replica
+# TYPE simclr_serve_weight_swaps_total counter
+simclr_serve_weight_swaps_total 0
+# HELP simclr_serve_swap_rejected_total Checkpoint swaps refused (corrupt/unverified/incompatible); prior generation kept
+# TYPE simclr_serve_swap_rejected_total counter
+simclr_serve_swap_rejected_total 0
 # HELP simclr_serve_avg_batch_fill Mean requests per dispatched batch
 # TYPE simclr_serve_avg_batch_fill gauge
 simclr_serve_avg_batch_fill 2.5

@@ -255,3 +255,359 @@ class TestNeighborsEndpoint:
         assert metric_value(text, "simclr_serve_neighbors_requests_total") >= 1
         assert metric_value(text, "simclr_serve_neighbors_queries_total") >= 2
         assert metric_value(text, "simclr_serve_corpus_hbm_bytes") > 0
+        assert metric_value(text, "simclr_serve_corpus_rows") == 21
+
+    def test_corpus_mutation_404_without_store(self, live_with_index):
+        # the fixture serves a plain NeighborIndex (no MutableCorpus):
+        # mutations must 404 with a pointer at the store config, not crash
+        status, body, _ = live_with_index.request(
+            "POST", "/v1/corpus/upsert",
+            {"ids": [0], "embeddings": np.ones((1, 16)).tolist()},
+        )
+        assert status == 404
+        assert "corpus store" in json.loads(body)["error"]
+
+
+def clustered(n, d, n_centers, seed, row_noise=0.1, q_noise=0.05, n_queries=128):
+    """Clustered corpus + perturbed-row queries — the retrieval workload
+    shape (iid rows have vanishing top-k score gaps, making quantization
+    and ANN recall meaningless)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    corpus = (
+        centers[rng.integers(0, n_centers, n)]
+        + row_noise * rng.standard_normal((n, d))
+    ).astype(np.float32)
+    queries = (
+        corpus[rng.integers(0, n, n_queries)]
+        + q_noise * rng.standard_normal((n_queries, d))
+    ).astype(np.float32)
+    return corpus, queries
+
+
+def recall_vs_oracle(index, corpus, queries, k=10):
+    """Mean recall@k of ``index`` against float64 exact top-k sets."""
+    scores = np.asarray(queries, np.float64) @ np.asarray(corpus, np.float64).T
+    hits = total = 0
+    for i in range(0, queries.shape[0], index.max_queries):
+        _, idx = index.query(queries[i : i + index.max_queries], k)
+        for row, s in zip(idx, scores[i : i + index.max_queries]):
+            truth = set(np.argpartition(-s, k)[:k].tolist())
+            hits += len(set(int(v) for v in row) & truth)
+            total += k
+    return hits / total
+
+
+class TestQuantizedCorpus:
+    def test_int8_recall_and_measured_hbm_matches_analytic(self):
+        from simclr_tpu.parallel.compress import corpus_storage_bytes
+
+        corpus, queries = clustered(4096, 128, n_centers=64, seed=3)
+        metrics = ServeMetrics()
+        index = NeighborIndex(
+            corpus, max_queries=64, corpus_dtype="int8", metrics=metrics
+        )
+        # capacity claim first: the bucketed int8 shard must measure exactly
+        # what the analytic model predicts, and beat fp32 by >= 3.9x
+        state = index.hbm_state()
+        assert state["corpus_dtype"] == "int8"
+        analytic = corpus_storage_bytes(4096, 128, "int8", shards=index.n_shards)
+        assert state["corpus_hbm_bytes"] == analytic
+        fp32_bytes = corpus_storage_bytes(4096, 128, "fp32", shards=index.n_shards)
+        assert fp32_bytes / analytic >= 3.9
+        assert metrics.corpus_hbm_bytes.value == analytic
+        assert metrics.corpus_rows.value == 4096
+        # quality claim: recall@10 against the float64 exact oracle
+        assert recall_vs_oracle(index, corpus, queries) >= 0.99
+
+    def test_rejects_unknown_dtype(self):
+        with pytest.raises(ValueError, match="corpus_dtype"):
+            NeighborIndex(np.ones((4, 2), np.float32), corpus_dtype="fp16")
+
+
+class TestIVF:
+    def test_recall_monotone_in_probe_and_exact_at_full(self):
+        # continuous random floats: no score ties, so the probe == cells
+        # candidate set must reproduce the exact path's top-k SET exactly
+        rng = np.random.default_rng(17)
+        corpus = rng.standard_normal((256, 16)).astype(np.float32)
+        queries = rng.standard_normal((16, 16)).astype(np.float32)
+        exact = NeighborIndex(corpus, max_queries=16)
+        _, exact_idx = exact.query(queries, k=10)
+        cells = 8
+        prev = -1.0
+        for probe in (1, 2, 4, 8):
+            index = NeighborIndex(
+                corpus, max_queries=16, ann_cells=cells, ann_probe=probe
+            )
+            assert index.ann_cells == cells and index.ann_probe == probe
+            r = recall_vs_oracle(index, corpus, queries)
+            assert r >= prev - 1e-9, f"recall regressed at probe={probe}"
+            prev = r
+            if probe == cells:
+                assert r == 1.0
+                _, idx = index.query(queries, k=10)
+                for got, want in zip(idx.tolist(), exact_idx.tolist()):
+                    assert set(got) == set(want)
+
+    def test_int8_ivf_full_probe_high_recall(self):
+        corpus, queries = clustered(
+            2048, 64, n_centers=32, seed=5, n_queries=64
+        )
+        index = NeighborIndex(
+            corpus, max_queries=64, corpus_dtype="int8",
+            ann_cells=16, ann_probe=16,
+        )
+        assert recall_vs_oracle(index, corpus, queries) >= 0.95
+
+    def test_k_beyond_probed_candidates_rejected(self):
+        rng = np.random.default_rng(19)
+        corpus = rng.standard_normal((256, 8)).astype(np.float32)
+        index = NeighborIndex(corpus, max_queries=4, ann_cells=32, ann_probe=1)
+        cand = index.n_shards * index.ann_probe * index.cell_rows
+        assert cand < 256
+        with pytest.raises(ValueError, match="candidates reachable"):
+            index.query(corpus[:1], k=cand + 1)
+        index.query(corpus[:1], k=min(cand, 256))  # boundary is fine
+
+    def test_hbm_state_and_probe_gauge(self):
+        metrics = ServeMetrics()
+        index = NeighborIndex(
+            int_valued((64, 8), seed=20), max_queries=4,
+            ann_cells=4, ann_probe=2, metrics=metrics,
+        )
+        state = index.hbm_state()
+        assert state["ann_cells"] == 4 and state["ann_probe"] == 2
+        assert state["cell_rows"] == index.cell_rows > 0
+        assert metrics.ann_cells_probed.value == 2
+        # exact scan reports 0 probed cells (the "not ANN" sentinel)
+        m2 = ServeMetrics()
+        NeighborIndex(int_valued((8, 4), seed=21), metrics=m2)
+        assert m2.ann_cells_probed.value == 0
+        text = m2.render()
+        assert "simclr_serve_corpus_rows" in text
+        assert "simclr_serve_ann_cells_probed" in text
+
+
+class TestMutableCorpusStore:
+    def test_upsert_delete_replace_semantics(self):
+        from simclr_tpu.serve.retrieval import MutableCorpus
+
+        corpus = int_valued((12, 8), seed=22)
+        store = MutableCorpus(corpus, generation=5, max_queries=4)
+        assert store.generation == 5 and store.rows == 12
+        assert np.array_equal(store.index.row_ids, np.arange(12))
+
+        # upsert: one update in place + one fresh row
+        new_row = np.full((1, 8), 9.0, np.float32)
+        out = store.upsert([3, 100], np.concatenate([new_row, new_row * 2]))
+        assert out == {"generation": 6, "rows": 13}
+        assert store.index.generation == 6
+        assert int(store.index.row_ids[-1]) == 100
+        # the fresh row is its own nearest neighbor, reported by EXTERNAL id
+        _, idx = store.index.query(new_row * 2, k=1)
+        assert int(store.index.row_ids[int(idx[0, 0])]) == 100
+
+        out = store.delete([100])
+        assert out == {"generation": 7, "rows": 12}
+        assert 100 not in set(store.index.row_ids.tolist())
+
+        # replace: generation stays monotone even with a stale tag
+        out = store.replace(int_valued((6, 8), seed=23), generation=2)
+        assert out["generation"] == 8 and store.rows == 6
+        out = store.replace(int_valued((6, 8), seed=24), generation=50)
+        assert out["generation"] == 50
+
+    def test_delete_validates_ids(self):
+        from simclr_tpu.serve.retrieval import MutableCorpus
+
+        store = MutableCorpus(int_valued((4, 4), seed=25), max_queries=2)
+        with pytest.raises(ValueError, match="unknown corpus ids"):
+            store.delete([77])
+        with pytest.raises(ValueError, match="every corpus row"):
+            store.delete([0, 1, 2, 3])
+        with pytest.raises(ValueError, match="unique"):
+            MutableCorpus(int_valued((3, 4), seed=26), ids=[1, 1, 2])
+
+    def test_from_file_memmaps_npy(self, tmp_path):
+        from simclr_tpu.serve.retrieval import MutableCorpus, _load_corpus
+
+        corpus = int_valued((10, 6), seed=27)
+        path = tmp_path / "corpus.npy"
+        np.save(path, corpus)
+        # the loader must hand back the map itself, not a RAM copy
+        loaded = _load_corpus(str(path))
+        assert isinstance(loaded, np.memmap)
+        store = MutableCorpus.from_file(str(path), max_queries=4)
+        _, idx = store.index.query(corpus[:2], k=1)
+        _, ref_idx = oracle_topk(corpus, corpus[:2], 1)
+        np.testing.assert_array_equal(idx, ref_idx)
+        # first mutation materializes a private copy off the read-only map
+        store.upsert([99], np.ones((1, 6), np.float32))
+        assert store.rows == 11
+
+
+@pytest.fixture
+def live_with_store():
+    import jax.numpy as jnp
+
+    from simclr_tpu.serve.engine import EmbedEngine
+    from simclr_tpu.serve.retrieval import MutableCorpus
+    from simclr_tpu.serve.server import shutdown_gracefully, start_server
+    from tests.helpers import TinyContrastive
+    from tests.test_serve_server import LiveServer, serve_cfg
+
+    corpus = int_valued((24, 16), seed=30)
+    model = TinyContrastive(bn_cross_replica_axis=None)
+    variables = jax.tree.map(
+        np.asarray, model.init(jax.random.key(0), jnp.zeros((2, 32, 32, 3)))
+    )
+    metrics = ServeMetrics()
+    engine = EmbedEngine(model, variables, max_batch=8, metrics=metrics)
+    store = MutableCorpus(corpus, metrics=metrics, max_queries=8)
+    server, batcher = start_server(
+        serve_cfg(**{"serve.neighbors_k": 3}),
+        engine=engine, metrics=metrics, corpus_store=store,
+    )
+    ls = LiveServer(server, batcher, engine, metrics)
+    ls.corpus = corpus
+    ls.store = store
+    yield ls
+    shutdown_gracefully(server, drain_timeout_s=10)
+    ls.thread.join(timeout=10)
+    server.server_close()
+
+
+class TestCorpusEndpoints:
+    """Live-corpus mutations through HTTP (upsert/delete + generation)."""
+
+    def test_upsert_then_query_returns_external_id(self, live_with_store):
+        from tests.test_serve_server import metric_value
+
+        probe_row = np.full((1, 16), 50.0, np.float32)
+        status, body, headers = live_with_store.request(
+            "POST", "/v1/corpus/upsert",
+            {"ids": [999], "embeddings": probe_row.tolist()},
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["status"] == "committed"
+        assert payload["generation"] == 1 and payload["rows"] == 25
+        assert headers["X-Corpus-Generation"] == "1"
+        # the fresh row dominates every dot product against itself
+        status, body, headers = live_with_store.request(
+            "POST", "/v1/neighbors", {"queries": probe_row.tolist(), "k": 1}
+        )
+        assert status == 200
+        assert json.loads(body)["ids"][0][0] == 999
+        assert headers["X-Corpus-Generation"] == "1"
+        text = live_with_store.request("GET", "/metrics")[1].decode()
+        assert metric_value(text, "simclr_serve_corpus_generation") == 1
+        assert metric_value(text, "simclr_serve_corpus_rows") == 25
+
+    def test_delete_removes_row(self, live_with_store):
+        probe_row = np.full((1, 16), 50.0, np.float32)
+        live_with_store.request(
+            "POST", "/v1/corpus/upsert",
+            {"ids": [7000], "embeddings": probe_row.tolist()},
+        )
+        status, body, headers = live_with_store.request(
+            "POST", "/v1/corpus/delete", {"ids": [7000]}
+        )
+        assert status == 200
+        assert json.loads(body)["rows"] == 24
+        status, body, _ = live_with_store.request(
+            "POST", "/v1/neighbors", {"queries": probe_row.tolist(), "k": 1}
+        )
+        assert json.loads(body)["ids"][0][0] != 7000
+
+    def test_bad_mutations_400(self, live_with_store):
+        req = live_with_store.request
+        assert req("POST", "/v1/corpus/upsert")[0] == 400  # no body
+        assert req("POST", "/v1/corpus/upsert", {"ids": [1]})[0] == 400
+        ragged = {"ids": [1], "embeddings": [[1.0, 2.0]]}  # dim mismatch
+        assert req("POST", "/v1/corpus/upsert", ragged)[0] == 400
+        assert req("POST", "/v1/corpus/delete", {"ids": [424242]})[0] == 400
+        all_ids = {"ids": list(range(24))}
+        assert req("POST", "/v1/corpus/delete", all_ids)[0] == 400
+        # failed mutations never advance the generation
+        assert live_with_store.store.generation == 0
+
+    def test_mutations_503_while_draining(self, live_with_store):
+        live_with_store.server.draining.set()
+        try:
+            status, _, headers = live_with_store.request(
+                "POST", "/v1/corpus/delete", {"ids": [0]}
+            )
+            assert status == 503 and "Retry-After" in headers
+        finally:
+            live_with_store.server.draining.clear()
+
+
+class TestTornSwapChaos:
+    def test_concurrent_replace_never_tears_a_response(self, live_with_store):
+        """Chaos contract: while a writer thread replaces the corpus with
+        slowed index builds, every concurrent /v1/neighbors response must
+        be internally consistent — its X-Corpus-Generation header and its
+        result must come from the SAME committed generation (no 5xx, no
+        stale result under a fresh header, no half-built index)."""
+        import threading
+        import time as _time
+        from unittest import mock
+
+        from simclr_tpu.serve.retrieval import NeighborIndex as NI
+
+        n, d = 24, 16
+        probe = np.ones((1, d), np.float32)
+        # generation g's corpus spikes row (g % n): the expected top-1 row
+        # index is a pure function of the generation that served the query
+        base = int_valued((n, d), seed=31, lo=-2, hi=2)
+        # generation 0 still serves the FIXTURE's corpus, not ``base``
+        expected = {0: int(np.argmax(live_with_store.corpus @ probe[0]))}
+        versions = {}
+        for g in range(1, 7):
+            c = base.copy()
+            c[g % n] = 100.0
+            versions[g] = c
+            expected[g] = g % n
+
+        real_build = NI._build_device_state
+
+        def slow_build(self, host, ann_cells, ann_probe):
+            _time.sleep(0.05)  # widen the stage window the swap must mask
+            return real_build(self, host, ann_cells, ann_probe)
+
+        failures = []
+
+        def writer():
+            try:
+                for g in range(1, 7):
+                    live_with_store.store.replace(versions[g], g)
+            except Exception as e:  # pragma: no cover - surfaced below
+                failures.append(repr(e))
+
+        with mock.patch.object(NI, "_build_device_state", slow_build):
+            t = threading.Thread(target=writer)
+            t.start()
+            seen = set()
+            try:
+                while t.is_alive():
+                    status, body, headers = live_with_store.request(
+                        "POST", "/v1/neighbors",
+                        {"queries": probe.tolist(), "k": 1},
+                    )
+                    assert status == 200, f"5xx under mutation: {body!r}"
+                    g = int(headers["X-Corpus-Generation"])
+                    idx = json.loads(body)["indices"][0][0]
+                    assert idx == expected[g], (
+                        f"torn read: generation {g} answered row {idx}, "
+                        f"expected {expected[g]}"
+                    )
+                    seen.add(g)
+            finally:
+                t.join(timeout=30)
+        assert not failures, failures
+        assert live_with_store.store.generation == 6
+        # the stream must actually have crossed generations mid-flight
+        assert len(seen) >= 2, f"chaos window too narrow: saw only {seen}"

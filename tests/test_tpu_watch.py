@@ -23,7 +23,8 @@ STAGES = (
     "allreduce_bench", "overlap_async", "augment_bench", "multihost_dryrun",
     "elastic_dryrun", "fleet_smoke", "cosched_smoke", "remat2048",
     "explore1024", "explore512", "supervisor_smoke", "obs_smoke",
-    "compile_audit", "superepoch", "serve_scale", "run_report",
+    "compile_audit", "superepoch", "serve_scale", "retrieval_bench",
+    "run_report",
 )
 
 
@@ -159,17 +160,32 @@ def _write_stub(tmp_path, fail_scripts=(), probe_ok=True, probe_ok_times=None,
         "echo 'superepoch_parity OK k=4 max_rel_loss_diff=1.20e-04'; "
         "echo 'superepoch_compiles_total 2'; "
         "echo 'superepoch_recompile_alarms_total 0';; esac",
-        # the serve_scale stage greps its stdout for an error-free payload
+        # serve_bench.py backs two stages with IDENTICAL argv — the mode
+        # lives in the environment (SERVE_BENCH_CORPUS_ROWS selects the
+        # retrieval sweep), so the stub branches on the env var, exactly
+        # like the real script. serve_scale greps for an error-free payload
         # whose scaling block proves >= 2 replicas, a p99 column, and a
-        # quiet recompile sentry (serve_bench.py exits 0 even on error);
-        # the *bench.py* case below also substring-matches this invocation,
-        # harmlessly re-touching the capture
+        # quiet recompile sentry; retrieval_bench greps for the retrieval
+        # metric with a recall column and a quiet sentry (the script exits
+        # 0 even on error in both modes). The *bench.py* case below also
+        # substring-matches this invocation, harmlessly re-touching the
+        # capture
         'case "$*" in *serve_bench.py*) '
+        'if [ -n "${SERVE_BENCH_CORPUS_ROWS:-}" ]; then '
+        'echo \'{"metric": "retrieval_requests_per_sec", "value": 104.1, '
+        '"unit": "req/s", "best_cell": "n100000-fp32-ivf", '
+        '"recall_at_10": {"n100000-fp32-exact": 1.0, '
+        '"n100000-fp32-ivf": 0.9789, "n100000-int8-exact": 0.9906, '
+        '"n100000-int8-ivf": 0.9707}, "recompile_alarms": 0, '
+        '"ann_cells": 1024, "ann_probe": 4, '
+        '"ivf_speedup": {"100000": 9.62}}\'; '
+        "else "
         'echo \'{"metric": "serve_requests_per_sec", "value": 406.7, '
         '"unit": "req/s", "p50_ms": 18.4, "p99_ms": 39.8, '
         '"recompile_alarms": 0, "replicas": 4, '
         '"scaling": {"replicas": 4, "single_rps": 195.2, '
-        '"multi_rps": 406.7, "speedup": 2.08}}\';; esac',
+        '"multi_rps": 406.7, "speedup": 2.08}}\'; '
+        "fi;; esac",
         # the run_report stage greps for a COMPUTED verdict (OK|REGRESSION):
         # a NO_DATA/NO_BASELINE report exits 0 but proves nothing
         'case "$*" in *simclr_tpu.obs.report*) '
@@ -633,6 +649,57 @@ def test_serve_scale_marker_requires_multi_replica_scaling(tmp_path):
     r, state, log = _run_oneshot(tmp_path)
     assert "serve_scale" not in _done(state)
     assert (state / "serve_scale.fails").exists()
+
+
+def test_retrieval_bench_runs_and_marks_done(tmp_path):
+    """The retrieval stage shares serve_bench.py with serve_scale but is
+    selected purely by SERVE_BENCH_CORPUS_ROWS in the environment — the
+    healthy-payload stub must earn BOTH markers in one window, proving the
+    two stages don't shadow each other despite identical argv."""
+    calls = _write_stub(tmp_path)
+    r, state, log = _run_oneshot(tmp_path)
+    assert "retrieval_bench" in _done(state)
+    assert "serve_scale" in _done(state)
+    # two separate bench invocations, two separate evidence files
+    assert (state / "retrieval_bench.out").exists()
+    assert (state / "serve_scale.out").exists()
+    assert '"recall_at_10"' in (state / "retrieval_bench.out").read_text()
+
+
+def test_retrieval_bench_marker_requires_recall_and_quiet_sentry(tmp_path):
+    """serve_bench.py exits 0 even when the retrieval sweep produced no
+    recall evidence — a payload without the recall column, with a recompile
+    alarm, or carrying an error field must not earn retrieval_bench.done."""
+    _write_stub(tmp_path)
+    stub = tmp_path / "bin" / "python"
+    stub.write_text(stub.read_text().replace('"recall_at_10": {', '"recall_gone": {'))
+    r, state, log = _run_oneshot(tmp_path)
+    assert "retrieval_bench" not in _done(state)
+    assert (state / "retrieval_bench.fails").exists()
+    assert "stage retrieval_bench FAILED" in log.read_text()
+    # the stages sharing the window must be untouched
+    assert "serve_scale" in _done(state)
+
+    # second contract: recall present but the serve-path sentry fired
+    stub.write_text(stub.read_text()
+                    .replace('"recall_gone": {', '"recall_at_10": {')
+                    .replace('"n100000-int8-ivf": 0.9707}, "recompile_alarms": 0',
+                             '"n100000-int8-ivf": 0.9707}, "recompile_alarms": 2'))
+    (state / "retrieval_bench.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "retrieval_bench" not in _done(state)
+    assert (state / "retrieval_bench.fails").exists()
+
+    # third contract: the last-ditch error payload also exits 0
+    stub.write_text(stub.read_text()
+                    .replace('"n100000-int8-ivf": 0.9707}, "recompile_alarms": 2',
+                             '"n100000-int8-ivf": 0.9707}, "recompile_alarms": 0')
+                    .replace('"ivf_speedup": {"100000": 9.62}}',
+                             '"ivf_speedup": {"100000": 9.62}, "error": "boom"}'))
+    (state / "retrieval_bench.fails").unlink()
+    r, state, log = _run_oneshot(tmp_path)
+    assert "retrieval_bench" not in _done(state)
+    assert (state / "retrieval_bench.fails").exists()
 
 
 def test_run_report_marker_requires_computed_verdict(tmp_path):
